@@ -1,0 +1,30 @@
+"""gubernator-tpu: a TPU-native distributed rate-limiting framework.
+
+A from-scratch rebuild of the capabilities of Gubernator (reference:
+/root/reference, mailgun/gubernator v2 — a stateless distributed
+rate-limiting microservice) designed TPU-first:
+
+- Per-key counter state lives on device as fixed-size struct-of-arrays
+  (a set-associative slot table), not a host LRU dict.
+- The token-bucket / leaky-bucket algorithms are branchless vectorized
+  lane arithmetic inside ONE jitted step function, not per-request
+  control flow (reference: algorithms.go).
+- Intra-node key sharding (reference: workers.go worker pool) becomes
+  mesh sharding of the slot table over TPU cores via shard_map.
+- GLOBAL async hit aggregation (reference: global.go) becomes psum /
+  all_gather collectives over the ICI mesh.
+- The host side (gRPC frontend, batching, consistent-hash peer routing,
+  discovery, TLS, metrics) mirrors the reference's daemon surface.
+"""
+
+__version__ = "0.1.0"
+
+from gubernator_tpu.core.types import (  # noqa: F401
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitReq,
+    RateLimitResp,
+    HealthCheckResp,
+    has_behavior,
+)

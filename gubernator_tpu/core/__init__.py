@@ -1,0 +1,1 @@
+"""Core pure-Python layer: types, clock, calendar intervals, config, hashing."""

@@ -1,0 +1,212 @@
+"""Configuration system.
+
+Mirrors the reference's struct + `GUBER_*` env-var config (config.go:44-459,
+example.conf), extended with TPU-specific knobs (slot-table geometry, device
+batch shape, mesh axes).  Library users populate the dataclasses directly;
+the CLI calls `setup_daemon_config()` which reads the environment, with an
+optional KEY=VALUE config file loaded into the environment first
+(config.go:583-611).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+# Defaults from reference config.go:115-131, 300-301, lrucache.go:63.
+DEFAULT_BATCH_TIMEOUT_S = 0.5
+DEFAULT_BATCH_WAIT_S = 500e-6
+DEFAULT_BATCH_LIMIT = 1000
+DEFAULT_CACHE_SIZE = 50_000
+MAX_BATCH_SIZE = 1000  # gubernator.go:41
+
+
+@dataclass
+class BehaviorConfig:
+    """Batch / GLOBAL / multi-region timing knobs (config.go:44-65,115-127)."""
+
+    batch_timeout_s: float = DEFAULT_BATCH_TIMEOUT_S
+    batch_wait_s: float = DEFAULT_BATCH_WAIT_S
+    batch_limit: int = DEFAULT_BATCH_LIMIT
+
+    global_timeout_s: float = DEFAULT_BATCH_TIMEOUT_S
+    global_sync_wait_s: float = DEFAULT_BATCH_WAIT_S
+    global_batch_limit: int = DEFAULT_BATCH_LIMIT
+
+    multi_region_timeout_s: float = DEFAULT_BATCH_TIMEOUT_S
+    multi_region_sync_wait_s: float = DEFAULT_BATCH_WAIT_S
+    multi_region_batch_limit: int = DEFAULT_BATCH_LIMIT
+
+
+@dataclass
+class DeviceConfig:
+    """TPU-specific geometry (no reference analog — replaces the Go worker
+    pool's NumCPU/cache-per-worker arithmetic, workers.go:127-146).
+
+    The slot table holds `num_slots` entries arranged as
+    `num_slots // ways` buckets of `ways` slots.  `batch_size` is the fixed
+    device batch shape (requests are padded up to it — XLA recompiles on new
+    shapes, so it never varies at runtime).
+    """
+
+    num_slots: int = 65_536
+    ways: int = 8
+    batch_size: int = 1024
+    num_shards: int = 1  # mesh axis size for the sharded table
+    platform: Optional[str] = None  # None = jax default
+
+    def __post_init__(self) -> None:
+        if self.num_slots % (self.ways * max(self.num_shards, 1)) != 0:
+            raise ValueError(
+                "num_slots must be divisible by ways*num_shards "
+                f"(got {self.num_slots}, {self.ways}, {self.num_shards})"
+            )
+
+
+@dataclass
+class Config:
+    """Service-instance config (reference config.go:44-113)."""
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
+    cache_size: int = DEFAULT_CACHE_SIZE
+    data_center: str = ""
+    local_picker_hash: str = "fnv1a"  # or "fnv1" (config.go:403-425)
+    region_picker_hash: str = "fnv1a"
+    loader: Optional[object] = None  # runtime.store.Loader
+    store: Optional[object] = None  # runtime.store.Store
+
+
+@dataclass
+class DaemonConfig:
+    """Daemon assembly config (reference config.go:171-235)."""
+
+    grpc_listen_address: str = "localhost:1051"
+    http_listen_address: str = "localhost:1050"
+    advertise_address: str = ""
+    cache_size: int = DEFAULT_CACHE_SIZE
+    data_center: str = ""
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
+    peer_discovery_type: str = "none"  # none|static|dns
+    static_peers: List[str] = field(default_factory=list)
+    dns_fqdn: str = ""
+    dns_poll_interval_s: float = 10.0
+    log_level: str = "info"
+    # TLS (reference tls.go / config.go:338-368)
+    tls: Optional["TLSConfig"] = None
+    metric_flags: int = 0
+
+
+@dataclass
+class TLSConfig:
+    """Subset of reference TLSConfig (tls.go:46-138)."""
+
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    client_auth: str = ""  # ""|request|require|verify
+    insecure_skip_verify: bool = False
+
+
+def _env(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float_s(name: str, default: float) -> float:
+    """Duration env var in Go-style suffix notation or plain seconds."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return parse_duration_s(v)
+
+
+def parse_duration_s(v: str) -> float:
+    """Parse '500us' / '500ms' / '2s' / '1m' / plain float seconds."""
+    v = v.strip()
+    for suffix, mult in (("us", 1e-6), ("µs", 1e-6), ("ms", 1e-3),
+                         ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if v.endswith(suffix) and v[: -len(suffix)].replace(".", "").isdigit():
+            return float(v[: -len(suffix)]) * mult
+    return float(v)
+
+
+def load_config_file(path: str) -> None:
+    """Load KEY=VALUE lines into the environment (config.go:583-611)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                continue
+            k, _, val = line.partition("=")
+            os.environ[k.strip()] = val.strip()
+
+
+def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
+    """Build a DaemonConfig from GUBER_* env vars (config.go:253-459)."""
+    if config_file:
+        load_config_file(config_file)
+
+    behaviors = BehaviorConfig(
+        batch_timeout_s=_env_float_s("GUBER_BATCH_TIMEOUT", DEFAULT_BATCH_TIMEOUT_S),
+        batch_wait_s=_env_float_s("GUBER_BATCH_WAIT", DEFAULT_BATCH_WAIT_S),
+        batch_limit=_env_int("GUBER_BATCH_LIMIT", DEFAULT_BATCH_LIMIT),
+        global_timeout_s=_env_float_s("GUBER_GLOBAL_TIMEOUT", DEFAULT_BATCH_TIMEOUT_S),
+        global_sync_wait_s=_env_float_s("GUBER_GLOBAL_SYNC_WAIT", DEFAULT_BATCH_WAIT_S),
+        global_batch_limit=_env_int("GUBER_GLOBAL_BATCH_LIMIT", DEFAULT_BATCH_LIMIT),
+    )
+    device = DeviceConfig(
+        num_slots=_env_int("GUBER_TPU_NUM_SLOTS", 65_536),
+        ways=_env_int("GUBER_TPU_WAYS", 8),
+        batch_size=_env_int("GUBER_TPU_BATCH_SIZE", 1024),
+        num_shards=_env_int("GUBER_TPU_NUM_SHARDS", 1),
+        platform=os.environ.get("GUBER_TPU_PLATFORM") or None,
+    )
+    tls: Optional[TLSConfig] = None
+    if _env("GUBER_TLS_CERT") or _env("GUBER_TLS_CA"):
+        tls = TLSConfig(
+            ca_file=_env("GUBER_TLS_CA"),
+            cert_file=_env("GUBER_TLS_CERT"),
+            key_file=_env("GUBER_TLS_KEY"),
+            client_auth=_env("GUBER_TLS_CLIENT_AUTH"),
+            insecure_skip_verify=_env("GUBER_TLS_INSECURE_SKIP_VERIFY") == "true",
+        )
+    static_peers = [
+        p.strip() for p in _env("GUBER_PEERS").split(",") if p.strip()
+    ]
+    return DaemonConfig(
+        grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "localhost:1051"),
+        http_listen_address=_env("GUBER_HTTP_ADDRESS", "localhost:1050"),
+        advertise_address=_env("GUBER_ADVERTISE_ADDRESS", ""),
+        cache_size=_env_int("GUBER_CACHE_SIZE", DEFAULT_CACHE_SIZE),
+        data_center=_env("GUBER_DATA_CENTER", ""),
+        behaviors=behaviors,
+        device=device,
+        peer_discovery_type=_env(
+            "GUBER_PEER_DISCOVERY_TYPE", "static" if static_peers else "none"
+        ),
+        static_peers=static_peers,
+        dns_fqdn=_env("GUBER_DNS_FQDN", ""),
+        dns_poll_interval_s=_env_float_s("GUBER_DNS_POLL_INTERVAL", 10.0),
+        log_level=_env("GUBER_LOG_LEVEL", "info"),
+        tls=tls,
+    )
+
+
+def fast_test_behaviors() -> BehaviorConfig:
+    """Short windows for tests (reference cluster/cluster.go:119-125)."""
+    return BehaviorConfig(
+        batch_timeout_s=0.1,
+        batch_wait_s=0.01,
+        batch_limit=DEFAULT_BATCH_LIMIT,
+        global_timeout_s=0.1,
+        global_sync_wait_s=0.05,
+        global_batch_limit=DEFAULT_BATCH_LIMIT,
+    )

@@ -1,0 +1,57 @@
+"""Key hashing.
+
+Two consumers:
+
+1. The device slot table needs a 64-bit fingerprint per key string; we use
+   xxhash64 (the reference uses xxhash for its worker hash ring,
+   workers.go:47,154).  Hash value 0 is reserved as the empty-slot sentinel,
+   remapped to 1.
+
+2. The consistent-hash peer ring needs fnv1/fnv1a 64-bit string hashes
+   (reference replicated_hash.go:26,33 via segmentio/fasthash).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+import xxhash
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1_64(data: bytes) -> int:
+    """FNV-1 64-bit (multiply then xor) — fasthash/fnv1.HashString64."""
+    h = _FNV_OFFSET
+    for b in data:
+        h = (h * _FNV_PRIME) & _MASK64
+        h ^= b
+    return h
+
+
+def fnv1a_64(data: bytes) -> int:
+    """FNV-1a 64-bit (xor then multiply) — fasthash/fnv1a.HashString64."""
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+def key_hash64(key: str) -> int:
+    """64-bit device fingerprint of a hash key; never 0 (empty sentinel)."""
+    h = xxhash.xxh64_intdigest(key)
+    return h if h != 0 else 1
+
+
+def bulk_key_hash64(keys: Iterable[str]) -> np.ndarray:
+    """Vector of int64 fingerprints (two's-complement view of the uint64)."""
+    out: List[int] = []
+    for k in keys:
+        h = xxhash.xxh64_intdigest(k)
+        if h == 0:
+            h = 1
+        out.append(h)
+    return np.array(out, dtype=np.uint64).view(np.int64)

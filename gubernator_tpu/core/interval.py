@@ -1,0 +1,121 @@
+"""Gregorian calendar intervals + the one-shot interval ticker.
+
+Mirrors reference interval.go:29-148.  Duration values 0-5 select a calendar
+interval; expiry is the END of the current interval (e.g. for Minutes, the
+last millisecond of the current minute).
+"""
+from __future__ import annotations
+
+import asyncio
+import calendar
+from datetime import datetime, timedelta
+from typing import Optional
+
+GREGORIAN_MINUTES = 0
+GREGORIAN_HOURS = 1
+GREGORIAN_DAYS = 2
+GREGORIAN_WEEKS = 3
+GREGORIAN_MONTHS = 4
+GREGORIAN_YEARS = 5
+
+
+class GregorianError(ValueError):
+    pass
+
+
+def _to_ms(dt: datetime) -> int:
+    return int(dt.timestamp() * 1000)
+
+
+def gregorian_duration(now: datetime, d: int) -> int:
+    """Entire duration of the Gregorian interval containing `now`, in ms
+    (reference interval.go:84-109).
+
+    Deviation from the reference: interval.go:99 has an operator-precedence
+    bug for Months (`end.UnixNano() - begin.UnixNano()/1000000`); we return
+    the intended (end - begin) in milliseconds.
+    """
+    if d == GREGORIAN_MINUTES:
+        return 60_000
+    if d == GREGORIAN_HOURS:
+        return 3_600_000
+    if d == GREGORIAN_DAYS:
+        return 86_400_000
+    if d == GREGORIAN_WEEKS:
+        raise GregorianError("`Duration = GregorianWeeks` not yet supported")
+    if d == GREGORIAN_MONTHS:
+        days = calendar.monthrange(now.year, now.month)[1]
+        return days * 86_400_000
+    if d == GREGORIAN_YEARS:
+        days = 366 if calendar.isleap(now.year) else 365
+        return days * 86_400_000
+    raise GregorianError(
+        "behavior DURATION_IS_GREGORIAN is set; but `Duration` is not a valid "
+        "gregorian interval"
+    )
+
+
+def gregorian_expiration(now: datetime, d: int) -> int:
+    """End of the current Gregorian interval as unix ms
+    (reference interval.go:117-148).  E.g. Minutes → last ms of this minute.
+    """
+    if d == GREGORIAN_MINUTES:
+        start = now.replace(second=0, microsecond=0)
+        return _to_ms(start) + 60_000 - 1
+    if d == GREGORIAN_HOURS:
+        start = now.replace(minute=0, second=0, microsecond=0)
+        return _to_ms(start) + 3_600_000 - 1
+    if d == GREGORIAN_DAYS:
+        start = now.replace(hour=0, minute=0, second=0, microsecond=0)
+        return _to_ms(start) + 86_400_000 - 1
+    if d == GREGORIAN_WEEKS:
+        raise GregorianError("`Duration = GregorianWeeks` not yet supported")
+    if d == GREGORIAN_MONTHS:
+        start = now.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        days = calendar.monthrange(now.year, now.month)[1]
+        return _to_ms(start + timedelta(days=days)) - 1
+    if d == GREGORIAN_YEARS:
+        start = now.replace(
+            month=1, day=1, hour=0, minute=0, second=0, microsecond=0
+        )
+        return _to_ms(start.replace(year=start.year + 1)) - 1
+    raise GregorianError(
+        "behavior DURATION_IS_GREGORIAN is set; but `Duration` is not a valid "
+        "gregorian interval"
+    )
+
+
+class Interval:
+    """One-shot async ticker (reference interval.go:29-72).
+
+    `next()` arms the timer; `wait()` resolves one interval later.  Multiple
+    `next()` calls before the tick fires are coalesced.  This is the batching
+    heartbeat used by the peer batcher and the GLOBAL manager.
+    """
+
+    def __init__(self, delay_s: float) -> None:
+        self._delay = delay_s
+        self._armed = False
+        self._event = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    def next(self) -> None:
+        if self._armed:
+            return
+        self._armed = True
+        self._task = asyncio.get_running_loop().create_task(self._fire())
+
+    async def _fire(self) -> None:
+        await asyncio.sleep(self._delay)
+        self._event.set()
+
+    async def wait(self) -> None:
+        await self._event.wait()
+        self._event.clear()
+        self._armed = False
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._armed = False

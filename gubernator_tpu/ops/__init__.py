@@ -1,0 +1,10 @@
+"""Device ops layer: slot table, batch packing, vectorized bucket kernels.
+
+Importing this package enables JAX x64 mode — the protocol's counters and
+timestamps are int64 (proto gubernator.proto:140-161, store.go:29-43) and the
+leaky-bucket remainder is float64.  TPU executes both via XLA's 32-bit-pair
+emulation; the elementwise VPU work here is cheap relative to HBM traffic.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

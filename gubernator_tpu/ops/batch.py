@@ -1,0 +1,162 @@
+"""Host-side request packing into fixed-shape device batches.
+
+The device step has ONE compiled shape: [batch_size] lanes.  The host packs
+incoming RateLimitReq lists into padded arrays; anything data-dependent that
+JAX cannot trace (string hashing, Gregorian calendar math, duplicate-key
+rounds) happens here.
+
+Duplicate keys: the reference serializes same-key requests through one worker
+(workers.go:182-186), so each sees the state left by the previous.  A vmapped
+kernel would see stale reads for duplicates in one batch, so the packer splits
+a batch into ROUNDS — occurrence 0 of every key in round 0, occurrence 1 in
+round 1, ... — and the runtime applies rounds sequentially.  Round 1+ is
+almost always empty.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.hashing import key_hash64
+from gubernator_tpu.core.interval import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.core.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    has_behavior,
+)
+
+
+class DeviceBatch(NamedTuple):
+    """Fixed-shape [B] request lanes (the traced view of RateLimitReq)."""
+
+    key_hash: np.ndarray      # int64[B]; 0 on padding lanes
+    hits: np.ndarray          # int64[B]
+    limit: np.ndarray         # int64[B]
+    duration: np.ndarray      # int64[B]
+    algo: np.ndarray          # int32[B]
+    burst: np.ndarray         # int64[B]; already defaulted to limit when 0
+    reset_remaining: np.ndarray  # bool[B]
+    is_greg: np.ndarray       # bool[B]
+    greg_expire: np.ndarray   # int64[B]; host-precomputed interval end
+    greg_duration: np.ndarray  # int64[B]; host-precomputed full interval ms
+    active: np.ndarray        # bool[B]; False on padding lanes
+
+
+@dataclass
+class PackedRounds:
+    """One device batch split into sequential rounds for duplicate keys."""
+
+    rounds: List[DeviceBatch]
+    # For each original request i: (round_index, lane_index).
+    positions: List[Tuple[int, int]]
+    errors: Dict[int, str]  # request index -> validation error
+
+
+def pack_requests(
+    reqs: Sequence[RateLimitReq],
+    batch_size: int,
+    clock: Optional[clock_mod.Clock] = None,
+) -> PackedRounds:
+    """Pack requests into rounds of fixed-shape [batch_size] arrays.
+
+    Validation mirrors gubernator.go:228-237 (empty name / unique_key) plus
+    Gregorian interval validation (interval.go:107,147) — failed requests get
+    an error entry and no lane.
+    """
+    clock = clock or clock_mod.default_clock()
+    now_dt = clock.now()
+
+    positions: List[Tuple[int, int]] = [(-1, -1)] * len(reqs)
+    errors: Dict[int, str] = {}
+
+    # Assign each request to (round, lane).  Invariants: a key appears at
+    # most once per round (the kernel's unique-key contract), and occurrence
+    # k of a key lands in a strictly later round than occurrence k-1 (so
+    # same-key requests observe each other's effects in order).
+    last_round: Dict[str, int] = {}
+    round_keys: List[set] = []
+    per_round: List[List[Tuple[int, RateLimitReq]]] = []
+    for i, r in enumerate(reqs):
+        if not r.name:
+            errors[i] = "field 'name' cannot be empty"
+            continue
+        if not r.unique_key:
+            errors[i] = "field 'unique_key' cannot be empty"
+            continue
+        key = r.hash_key()
+        rnd = last_round.get(key, -1) + 1
+        while True:
+            if rnd >= len(per_round):
+                per_round.append([])
+                round_keys.append(set())
+            if len(per_round[rnd]) < batch_size and key not in round_keys[rnd]:
+                break
+            rnd += 1
+        last_round[key] = rnd
+        round_keys[rnd].add(key)
+        per_round[rnd].append((i, r))
+
+    rounds: List[DeviceBatch] = []
+    for rnd_idx, entries in enumerate(per_round):
+        b = _empty_batch(batch_size)
+        for lane, (i, r) in enumerate(entries):
+            positions[i] = (rnd_idx, lane)
+            err = _fill_lane(b, lane, r, now_dt)
+            if err is not None:
+                errors[i] = err
+                positions[i] = (-1, -1)
+                _clear_lane(b, lane)
+        rounds.append(b)
+
+    return PackedRounds(rounds=rounds, positions=positions, errors=errors)
+
+
+def _empty_batch(batch_size: int) -> DeviceBatch:
+    z64 = lambda: np.zeros(batch_size, dtype=np.int64)
+    return DeviceBatch(
+        key_hash=z64(),
+        hits=z64(),
+        limit=z64(),
+        duration=z64(),
+        algo=np.zeros(batch_size, dtype=np.int32),
+        burst=z64(),
+        reset_remaining=np.zeros(batch_size, dtype=bool),
+        is_greg=np.zeros(batch_size, dtype=bool),
+        greg_expire=z64(),
+        greg_duration=z64(),
+        active=np.zeros(batch_size, dtype=bool),
+    )
+
+
+def _fill_lane(b: DeviceBatch, lane: int, r: RateLimitReq, now_dt) -> Optional[str]:
+    is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
+    if is_greg:
+        try:
+            b.greg_expire[lane] = gregorian_expiration(now_dt, r.duration)
+            b.greg_duration[lane] = gregorian_duration(now_dt, r.duration)
+        except GregorianError as e:
+            return str(e)
+    b.key_hash[lane] = np.int64(np.uint64(key_hash64(r.hash_key())).view(np.int64))
+    b.hits[lane] = r.hits
+    b.limit[lane] = r.limit
+    b.duration[lane] = r.duration
+    b.algo[lane] = int(r.algorithm)
+    # Burst default (algorithms.go:271-272) applied host-side.
+    b.burst[lane] = r.burst if r.burst != 0 else r.limit
+    b.reset_remaining[lane] = has_behavior(r.behavior, Behavior.RESET_REMAINING)
+    b.is_greg[lane] = is_greg
+    b.active[lane] = True
+    return None
+
+
+def _clear_lane(b: DeviceBatch, lane: int) -> None:
+    for arr in b:
+        arr[lane] = 0

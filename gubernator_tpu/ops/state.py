@@ -1,0 +1,83 @@
+"""The slot table: device-resident rate-limit state.
+
+Replaces the reference's per-worker LRU dict (lrucache.go:32-223) with a
+fixed-size, W-way set-associative table held as a struct-of-arrays on device.
+A key's 64-bit fingerprint selects one bucket of `ways` slots; lookups gather
+all ways and match on the stored fingerprint; inserts pick a victim way
+(empty > expired > least-recently-touched).  Eviction is therefore
+bucket-local pseudo-LRU rather than the reference's global list LRU
+(lrucache.go:147-158) — the acceptable-loss design (architecture.md:5-11)
+makes early eviction safe: it can only briefly over-admit.
+
+All arrays share leading dimension S = num_slots so the table shards cleanly
+along axis 0 over a device mesh (see gubernator_tpu.parallel.mesh).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Slot `kind` values.
+KIND_BUCKET = 0
+KIND_CACHED_RESP = 1  # non-owner's cached GLOBAL broadcast (gubernator.go:464-479)
+
+
+class SlotTable(NamedTuple):
+    """Struct-of-arrays; one row = one CacheItem (cache.go:30-42) flattened
+    together with its TokenBucketItem / LeakyBucketItem payload
+    (store.go:29-43)."""
+
+    key: jax.Array         # int64[S]; xxhash64 fingerprint; 0 = empty
+    algo: jax.Array        # int32[S]; Algorithm enum
+    kind: jax.Array        # int32[S]; KIND_*
+    limit: jax.Array       # int64[S]
+    duration: jax.Array    # int64[S]
+    remaining: jax.Array   # int64[S]; token-bucket remaining / cached-resp remaining
+    remaining_f: jax.Array  # float64[S]; leaky-bucket fractional remaining
+    t0: jax.Array          # int64[S]; token CreatedAt / leaky UpdatedAt
+    status: jax.Array      # int32[S]; token-bucket sticky status / cached-resp status
+    burst: jax.Array       # int64[S]
+    expire_at: jax.Array   # int64[S]; unix ms (CacheItem.ExpireAt)
+    touched: jax.Array     # int64[S]; last-access stamp for victim choice
+
+    @property
+    def num_slots(self) -> int:
+        return self.key.shape[0]
+
+    def occupancy(self) -> jax.Array:
+        return jnp.sum(self.key != 0)
+
+
+def init_table(num_slots: int) -> SlotTable:
+    """All-empty table.  num_slots must keep num_slots/ways a power of two
+    (enforced at step-build time) so bucket selection is a mask, not a mod."""
+    i64 = lambda: jnp.zeros((num_slots,), dtype=jnp.int64)
+    i32 = lambda: jnp.zeros((num_slots,), dtype=jnp.int32)
+    return SlotTable(
+        key=i64(),
+        algo=i32(),
+        kind=i32(),
+        limit=i64(),
+        duration=i64(),
+        remaining=i64(),
+        remaining_f=jnp.zeros((num_slots,), dtype=jnp.float64),
+        t0=i64(),
+        status=i32(),
+        burst=i64(),
+        expire_at=i64(),
+        touched=i64(),
+    )
+
+
+def table_to_host(table: SlotTable) -> dict:
+    """DMA the table down as numpy for snapshot/Loader-save
+    (the device analog of WorkerPool.Store streaming cache.Each(),
+    workers.go:467-530)."""
+    return {f: np.asarray(getattr(table, f)) for f in table._fields}
+
+
+def table_from_host(arrs: dict) -> SlotTable:
+    return SlotTable(**{f: jnp.asarray(arrs[f]) for f in SlotTable._fields})

@@ -1,0 +1,349 @@
+"""The device step: batched lookup/insert + branchless bucket algorithms.
+
+One jitted call applies a whole padded batch of rate-limit checks against the
+slot table and returns per-lane responses:
+
+    table', resp = apply_batch(table, batch, now)
+
+This replaces the reference's per-request path
+(worker channel -> algorithm fn -> LRU dict, workers.go:249-314 +
+algorithms.go) with: bucket gather -> victim/claim resolution -> lane
+arithmetic -> scatter.  Every ordered special case in algorithms.go is
+re-derived as `jnp.where` lane selects; the differential test
+(tests/test_differential.py) drives random op streams through this and the
+sequential oracle (core/pymodel.py) and requires identical decisions.
+
+Design notes:
+- Lookup is W-way set-associative: bucket = key_hash & (num_buckets-1);
+  num_buckets must be a power of two.
+- Expired slots do not match (the reference cache returns a miss for expired
+  items, lrucache.go:115-127); a request whose own slot expired prefers
+  reusing that slot.
+- Within-batch insert conflicts (two new keys choosing the same victim slot)
+  are resolved with sort-based claim rounds — no O(num_slots) temporaries.
+  After INSERT_ROUNDS, unresolved lanes are answered as "transient" new items
+  (correct response, state not persisted) — the same acceptable-loss contract
+  as reference cache eviction (architecture.md:5-11).
+- Duplicate keys within a batch are the host packer's job (ops/batch.py
+  rounds); this kernel assumes each active key appears once.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.state import KIND_BUCKET, SlotTable
+
+ALGO_TOKEN = 0
+ALGO_LEAKY = 1
+UNDER = 0
+OVER = 1
+
+INSERT_ROUNDS = 3
+
+
+class Resp(NamedTuple):
+    """Per-lane response arrays (RateLimitResp, gubernator.proto:169-182)."""
+
+    status: jax.Array     # int32[B]
+    limit: jax.Array      # int64[B]
+    remaining: jax.Array  # int64[B]
+    reset_time: jax.Array  # int64[B]
+    persisted: jax.Array  # bool[B]; False = transient (state not stored)
+    found: jax.Array      # bool[B]; matched a live slot
+
+
+class DeviceBatchJ(NamedTuple):
+    """Device-side mirror of ops.batch.DeviceBatch."""
+
+    key_hash: jax.Array
+    hits: jax.Array
+    limit: jax.Array
+    duration: jax.Array
+    algo: jax.Array
+    burst: jax.Array
+    reset_remaining: jax.Array
+    is_greg: jax.Array
+    greg_expire: jax.Array
+    greg_duration: jax.Array
+    active: jax.Array
+
+
+def _f64(x: jax.Array) -> jax.Array:
+    return x.astype(jnp.float64)
+
+
+def _trunc_i64(x: jax.Array) -> jax.Array:
+    """Go's int64(float64): truncation toward zero (XLA convert semantics)."""
+    return x.astype(jnp.int64)
+
+
+def _first_claim(tgt: jax.Array, attempt: jax.Array) -> jax.Array:
+    """Of all lanes attempting the same target slot, the lowest lane wins.
+
+    Sort-based, O(B log B), no table-sized temporaries.  Returns bool[B]
+    winner mask.
+    """
+    sent = jnp.int64(1) << 62
+    v = jnp.where(attempt, tgt, sent)
+    order = jnp.argsort(v, stable=True)  # stable: equal slots -> lane order
+    v_sorted = v[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), v_sorted[1:] != v_sorted[:-1]]
+    )
+    win_sorted = first & (v_sorted != sent)
+    return jnp.zeros(tgt.shape, dtype=bool).at[order].set(win_sorted)
+
+
+def _member_of(sorted_vals: jax.Array, queries: jax.Array) -> jax.Array:
+    """Membership of `queries` in `sorted_vals` via searchsorted."""
+    pos = jnp.searchsorted(sorted_vals, queries)
+    pos = jnp.clip(pos, 0, sorted_vals.shape[0] - 1)
+    return sorted_vals[pos] == queries
+
+
+@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
+def apply_batch(
+    table: SlotTable,
+    batch: DeviceBatchJ,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[SlotTable, Resp]:
+    """Apply one padded batch; returns (new_table, responses)."""
+    S = table.key.shape[0]
+    nb = S // ways
+    if nb & (nb - 1):
+        raise ValueError(f"num_buckets ({nb}) must be a power of two")
+    B = batch.key_hash.shape[0]
+    now = jnp.asarray(now, dtype=jnp.int64)
+
+    h = batch.key_hash
+    active = batch.active
+    lane = jnp.arange(B, dtype=jnp.int64)
+
+    bucket = (h.astype(jnp.uint64) & jnp.uint64(nb - 1)).astype(jnp.int64)
+    sidx = bucket[:, None] * ways + jnp.arange(ways, dtype=jnp.int64)[None, :]
+
+    cand_key = table.key[sidx]          # [B, W]
+    cand_expire = table.expire_at[sidx]
+    cand_touched = table.touched[sidx]
+
+    keymatch = (cand_key == h[:, None]) & active[:, None]
+    live = cand_expire > now
+    match = keymatch & live
+    found = match.any(axis=1)
+    match_slot = bucket * ways + jnp.argmax(match, axis=1)
+
+    # ---- victim scoring for inserts ------------------------------------
+    # Preference: my own expired slot > empty > other expired > oldest touch.
+    empty = cand_key == 0
+    mine_stale = keymatch & ~live
+    klass = jnp.where(
+        mine_stale, 0, jnp.where(empty, 1, jnp.where(~live, 2, 3))
+    ).astype(jnp.int64)
+    vscore = klass * (jnp.int64(1) << 48) + cand_touched  # touched < 2^48 ms
+
+    need = active & ~found
+    inf = jnp.int64(1) << 62
+    insert_slot = jnp.full((B,), -1, dtype=jnp.int64)
+    won = jnp.zeros((B,), dtype=bool)
+
+    for _ in range(INSERT_ROUNDS):
+        # Slots reserved this batch: live matches + already-won inserts.
+        reserved = jnp.sort(
+            jnp.concatenate(
+                [
+                    jnp.where(found, match_slot, -1),
+                    jnp.where(won, insert_slot, -1),
+                ]
+            )
+        )
+        blocked = _member_of(reserved, sidx.ravel()).reshape(sidx.shape)
+        vs = jnp.where(blocked, inf, vscore)
+        vmin = jnp.min(vs, axis=1)
+        vslot = bucket * ways + jnp.argmin(vs, axis=1)
+        attempt = need & ~won & (vmin < inf)
+        win_now = _first_claim(vslot, attempt)
+        insert_slot = jnp.where(win_now, vslot, insert_slot)
+        won = won | win_now
+
+    persist = found | won
+    slot = jnp.where(found, match_slot, jnp.where(won, insert_slot, 0))
+    slot_safe = jnp.clip(slot, 0, S - 1)
+
+    # ---- gather current rows -------------------------------------------
+    g = lambda a: a[slot_safe]
+    s_algo = g(table.algo)
+    s_kind = g(table.kind)
+    s_limit = g(table.limit)
+    s_dur = g(table.duration)
+    s_rem = g(table.remaining)
+    s_rem_f = g(table.remaining_f)
+    s_t0 = g(table.t0)
+    s_status = g(table.status)
+    s_burst = g(table.burst)
+    s_expire = g(table.expire_at)
+
+    r_hits, r_lim, r_dur = batch.hits, batch.limit, batch.duration
+    r_burst = batch.burst
+    is_greg = batch.is_greg
+    greg_exp = batch.greg_expire
+    greg_dur = batch.greg_duration
+    req_token = batch.algo == ALGO_TOKEN
+    req_leaky = batch.algo == ALGO_LEAKY
+    reset = batch.reset_remaining
+
+    is_bucket_row = found & (s_kind == KIND_BUCKET)
+    # Path selection (see module docstring):
+    tok_clear = req_token & reset & found  # algorithms.go:78-90 (pre type check)
+    tok_exist = req_token & ~reset & is_bucket_row & (s_algo == ALGO_TOKEN)
+    lky_exist = req_leaky & is_bucket_row & (s_algo == ALGO_LEAKY)
+    is_new = active & ~tok_clear & ~tok_exist & ~lky_exist
+
+    # ==== token bucket, existing item (algorithms.go:112-195) ===========
+    limit_changed = s_limit != r_lim
+    rem0 = jnp.where(
+        limit_changed, jnp.maximum(s_rem + r_lim - s_limit, 0), s_rem
+    )
+    dur_changed = s_dur != r_dur
+    expire1 = jnp.where(is_greg, greg_exp, s_t0 + r_dur)
+    renew = dur_changed & (expire1 <= now)
+    te_expire = jnp.where(
+        dur_changed, jnp.where(renew, now + r_dur, expire1), s_expire
+    )
+    te_t0 = jnp.where(renew, now, s_t0)
+    rem1 = jnp.where(renew, r_lim, rem0)
+
+    h0 = r_hits == 0
+    # "Already at the limit" tests the RESPONSE remaining (rem0, set before
+    # the duration-renew branch mutates item remaining) — algorithms.go:167.
+    over_zero = ~h0 & (rem0 == 0) & (r_hits > 0)
+    exact = ~h0 & ~over_zero & (rem1 == r_hits)  # algorithms.go:176 (item rem)
+    over_more = ~h0 & ~over_zero & ~exact & (r_hits > rem1)
+    under = ~h0 & ~over_zero & ~exact & ~over_more
+
+    te_rem = jnp.where(exact, 0, jnp.where(under, rem1 - r_hits, rem1))
+    te_status = jnp.where(over_zero, OVER, s_status)
+    te_resp_status = jnp.where(over_zero | over_more, OVER, s_status)
+    te_resp_rem = jnp.where(exact | under, te_rem, rem0)
+    te_resp_reset = te_expire
+
+    # ==== token bucket, new item (algorithms.go:203-258) ================
+    tn_over = r_hits > r_lim
+    tn_rem = jnp.where(tn_over, r_lim, r_lim - r_hits)
+    tn_expire = jnp.where(is_greg, greg_exp, now + r_dur)
+    tn_resp_status = jnp.where(tn_over, OVER, UNDER)
+
+    # ==== leaky bucket, existing item (algorithms.go:327-426) ===========
+    lb0 = jnp.where(reset & req_leaky, _f64(r_burst), s_rem_f)
+    grow = (s_burst != r_burst) & (r_burst > _trunc_i64(lb0))
+    lb1 = jnp.where(grow, _f64(r_burst), lb0)
+    l_dur_c = jnp.where(is_greg, greg_exp - now, r_dur)
+    safe_lim = jnp.where(r_lim == 0, 1, r_lim)
+    l_rate = jnp.where(
+        r_lim == 0,
+        0.0,
+        jnp.where(is_greg, _f64(greg_dur), _f64(r_dur)) / _f64(safe_lim),
+    )
+    le_expire = jnp.where(r_hits != 0, now + l_dur_c, s_expire)
+    elapsed = _f64(now - s_t0)
+    leak = jnp.where(l_rate != 0.0, elapsed / l_rate, 0.0)
+    leaked = _trunc_i64(leak) > 0
+    lb2 = jnp.where(leaked, lb1 + leak, lb1)
+    le_t0 = jnp.where(leaked, now, s_t0)
+    lb3 = jnp.where(_trunc_i64(lb2) > r_burst, _f64(r_burst), lb2)
+    lrem_i = _trunc_i64(lb3)
+    lrate_i = _trunc_i64(l_rate)
+
+    l_over_zero = (lrem_i == 0) & (r_hits > 0)
+    l_exact = ~l_over_zero & (lrem_i == r_hits)
+    l_over_more = ~l_over_zero & ~l_exact & (r_hits > lrem_i)
+    l_take = l_exact | (~l_over_zero & ~l_exact & ~l_over_more & (r_hits != 0))
+    lb4 = jnp.where(l_take, lb3 - _f64(r_hits), lb3)
+    le_resp_rem = jnp.where(
+        l_exact, 0, jnp.where(l_take, _trunc_i64(lb4), lrem_i)
+    )
+    le_resp_reset = jnp.where(
+        l_take,
+        now + (r_lim - le_resp_rem) * lrate_i,
+        now + (r_lim - lrem_i) * lrate_i,
+    )
+    le_resp_status = jnp.where(l_over_zero | l_over_more, OVER, UNDER)
+
+    # ==== leaky bucket, new item (algorithms.go:433-492) ================
+    # Quirk preserved: rate uses RAW r.duration even under Gregorian
+    # (algorithms.go:441 computes rate before the adjustment).
+    ln_rate_i = _trunc_i64(
+        jnp.where(r_lim == 0, 0.0, _f64(r_dur) / _f64(safe_lim))
+    )
+    ln_dur = jnp.where(is_greg, greg_exp - now, r_dur)
+    ln_over = r_hits > r_burst
+    ln_rem_f = jnp.where(ln_over, 0.0, _f64(r_burst - r_hits))
+    ln_resp_rem = jnp.where(ln_over, 0, r_burst - r_hits)
+    ln_resp_reset = now + (r_lim - ln_resp_rem) * ln_rate_i
+    ln_resp_status = jnp.where(ln_over, OVER, UNDER)
+    ln_expire = now + ln_dur
+
+    # ==== select per-lane outputs =======================================
+    tok_new = is_new & req_token
+    lky_new = is_new & req_leaky
+
+    def sel(te, tn, le, ln, clear):
+        x = jnp.where(tok_exist, te, 0)
+        x = jnp.where(tok_new, tn, x)
+        x = jnp.where(lky_exist, le, x)
+        x = jnp.where(lky_new, ln, x)
+        return jnp.where(tok_clear, clear, x)
+
+    resp = Resp(
+        status=sel(
+            te_resp_status, tn_resp_status, le_resp_status, ln_resp_status,
+            UNDER,
+        ).astype(jnp.int32),
+        limit=jnp.where(active, r_lim, 0),
+        remaining=sel(te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem, r_lim),
+        reset_time=sel(te_resp_reset, tn_expire, le_resp_reset, ln_resp_reset, 0),
+        persisted=persist & active,
+        found=found,
+    )
+
+    # ==== write back ====================================================
+    do_write = persist & active
+    tgt = jnp.where(do_write, slot, S)  # S -> dropped by scatter mode
+
+    n_key = jnp.where(tok_clear, 0, h)
+    n_algo = jnp.where(tok_clear, 0, batch.algo).astype(jnp.int32)
+    n_kind = jnp.zeros_like(s_kind)
+    n_limit = sel(r_lim, r_lim, r_lim, r_lim, 0)
+    # Stored duration: leaky-existing stores RAW r.duration (algorithms.go:340)
+    # but leaky-new stores the COMPUTED duration (algorithms.go:457).
+    n_dur = sel(r_dur, r_dur, r_dur, ln_dur, 0)
+    n_rem = sel(te_rem, tn_rem, 0, 0, 0)
+    n_rem_f = sel(0.0, 0.0, lb4, ln_rem_f, 0.0)
+    n_t0 = sel(te_t0, now, le_t0, now, 0)
+    n_status = sel(te_status, UNDER, 0, 0, 0).astype(jnp.int32)
+    n_burst = sel(s_burst, 0, r_burst, r_burst, 0)
+    n_expire = sel(te_expire, tn_expire, le_expire, ln_expire, 0)
+    n_touched = jnp.where(tok_clear, 0, now)
+
+    def scat(arr, val):
+        return arr.at[tgt].set(val.astype(arr.dtype), mode="drop")
+
+    new_table = SlotTable(
+        key=scat(table.key, n_key),
+        algo=scat(table.algo, n_algo),
+        kind=scat(table.kind, n_kind),
+        limit=scat(table.limit, n_limit),
+        duration=scat(table.duration, n_dur),
+        remaining=scat(table.remaining, n_rem),
+        remaining_f=scat(table.remaining_f, n_rem_f),
+        t0=scat(table.t0, n_t0),
+        status=scat(table.status, n_status),
+        burst=scat(table.burst, n_burst),
+        expire_at=scat(table.expire_at, n_expire),
+        touched=scat(table.touched, n_touched),
+    )
+    return new_table, resp

@@ -1,0 +1,1 @@
+"""Host runtime: device backend, batcher, service, peers, daemon."""

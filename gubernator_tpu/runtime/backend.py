@@ -1,0 +1,165 @@
+"""Device backend: the intra-node engine behind the service instance.
+
+Replaces the reference WorkerPool (workers.go:56-664).  Where the reference
+shards the key space across NumCPU goroutine workers each owning a private
+LRU, this backend owns ONE device-resident slot table and applies whole
+batches in a single jitted step — intra-node parallelism comes from vector
+lanes, not threads.  (The multi-chip version shards the same table over a
+mesh axis; see gubernator_tpu.parallel.mesh.)
+
+Synchronous by design: callers (the async batcher / service) serialize calls,
+which preserves the reference's single-writer-per-shard discipline
+(workers.go:19-37) at whole-table granularity.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+import gubernator_tpu.ops  # noqa: F401  (enables x64)
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import DeviceConfig
+from gubernator_tpu.core.hashing import key_hash64
+from gubernator_tpu.core.types import (
+    CacheItem,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
+from gubernator_tpu.ops.batch import DeviceBatch, pack_requests
+from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
+from gubernator_tpu.ops.step import DeviceBatchJ, apply_batch
+
+
+class DeviceBackend:
+    """Single-table rate-limit engine on one device (or CPU backend)."""
+
+    def __init__(
+        self,
+        cfg: Optional[DeviceConfig] = None,
+        clock: Optional[clock_mod.Clock] = None,
+    ) -> None:
+        self.cfg = cfg or DeviceConfig()
+        self.clock = clock or clock_mod.default_clock()
+        self._lock = threading.Lock()
+        if self.cfg.platform is not None:
+            self._device = jax.devices(self.cfg.platform)[0]
+        else:
+            self._device = jax.devices()[0]
+        with jax.default_device(self._device):
+            self.table: SlotTable = init_table(self.cfg.num_slots)
+        self._step = functools.partial(apply_batch, ways=self.cfg.ways)
+        # Running totals (metric parity: gubernator_over_limit_counter etc.)
+        self.checks = 0
+        self.over_limit = 0
+        self.not_persisted = 0
+
+    # -- hot path --------------------------------------------------------
+    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        """Apply a list of checks; returns responses in request order.
+
+        The packer splits duplicate keys into sequential rounds so same-key
+        requests observe each other's effects, like the reference's per-key
+        worker serialization (workers.go:182-186).
+        """
+        packed = pack_requests(reqs, self.cfg.batch_size, self.clock)
+        now = self.clock.millisecond_now()
+        out: List[Optional[RateLimitResp]] = [None] * len(reqs)
+
+        round_resps = []
+        with self._lock:
+            for db in packed.rounds:
+                self.table, resp = self._step(
+                    self.table, _to_device(db), np.int64(now)
+                )
+                round_resps.append(resp)
+        # One sync at the end of all rounds.
+        round_host = [
+            {
+                "status": np.asarray(r.status),
+                "remaining": np.asarray(r.remaining),
+                "reset_time": np.asarray(r.reset_time),
+                "limit": np.asarray(r.limit),
+                "persisted": np.asarray(r.persisted),
+            }
+            for r in round_resps
+        ]
+
+        for i in range(len(reqs)):
+            err = packed.errors.get(i)
+            if err is not None:
+                out[i] = RateLimitResp(error=err)
+                continue
+            rnd, lane = packed.positions[i]
+            r = round_host[rnd]
+            out[i] = RateLimitResp(
+                status=Status(int(r["status"][lane])),
+                limit=int(r["limit"][lane]),
+                remaining=int(r["remaining"][lane]),
+                reset_time=int(r["reset_time"][lane]),
+            )
+            self.checks += 1
+            if out[i].status == Status.OVER_LIMIT:
+                self.over_limit += 1
+            if not r["persisted"][lane]:
+                self.not_persisted += 1
+        return out  # type: ignore[return-value]
+
+    # -- cache item access (GLOBAL path + persistence SPI) ---------------
+    def get_cache_item(self, key: str) -> Optional[CacheItem]:
+        """Host-side point read of one key (WorkerPool.GetCacheItem,
+        workers.go:614-646).  Used by the GLOBAL read path and tests; reads
+        only the key's bucket (`ways` slots), not the whole table."""
+        h = int(np.uint64(key_hash64(key)).view(np.int64))
+        ways = self.cfg.ways
+        nb = self.cfg.num_slots // ways
+        bucket = key_hash64(key) & (nb - 1)
+        lo, hi = bucket * ways, (bucket + 1) * ways
+        with self._lock:
+            rows = {f: np.asarray(getattr(self.table, f)[lo:hi])
+                    for f in self.table._fields}
+        now = self.clock.millisecond_now()
+        for w in range(ways):
+            if rows["key"][w] == h and rows["expire_at"][w] > now:
+                return _row_to_item(rows, w, key)
+        return None
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        """Device->host DMA of the whole table (Loader save path,
+        workers.go:467-530)."""
+        with self._lock:
+            return table_to_host(self.table)
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return int(np.asarray(self.table.occupancy()))
+
+
+def _to_device(db: DeviceBatch) -> DeviceBatchJ:
+    return DeviceBatchJ(*[np.asarray(a) for a in db])
+
+
+def _row_to_item(snap: Dict[str, np.ndarray], s: int, key: str) -> CacheItem:
+    from gubernator_tpu.core.types import Algorithm
+
+    algo = Algorithm(int(snap["algo"][s]))
+    remaining: float
+    if algo == Algorithm.LEAKY_BUCKET:
+        remaining = float(snap["remaining_f"][s])
+    else:
+        remaining = int(snap["remaining"][s])
+    return CacheItem(
+        key=key,
+        algorithm=algo,
+        expire_at=int(snap["expire_at"][s]),
+        limit=int(snap["limit"][s]),
+        duration=int(snap["duration"][s]),
+        remaining=remaining,
+        created_at=int(snap["t0"][s]),
+        status=Status(int(snap["status"][s])),
+        burst=int(snap["burst"][s]),
+    )

@@ -1,0 +1,324 @@
+"""Behavior-table algorithm tests, ported from the reference functional suite
+(functional_test.go: TestTokenBucket:159, TestTokenBucketGregorian:220,
+TestTokenBucketNegativeHits:295, TestLeakyBucket:367, TestChangeLimit:870,
+TestResetRemaining:965, TestLeakyBucketDivBug:1106).
+
+Each case runs against BOTH engines — the sequential oracle
+(core.pymodel.PyRateLimiter) and the vectorized device backend
+(runtime.backend.DeviceBackend) — and must produce identical decisions.
+"""
+import pytest
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import DeviceConfig
+from gubernator_tpu.core.pymodel import PyRateLimiter
+from gubernator_tpu.core.types import (
+    MINUTE,
+    SECOND,
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.core.interval import GREGORIAN_MINUTES
+from gubernator_tpu.runtime.backend import DeviceBackend
+
+UNDER = Status.UNDER_LIMIT
+OVER = Status.OVER_LIMIT
+
+
+@pytest.fixture(params=["pymodel", "device"])
+def engine(request, frozen_clock):
+    if request.param == "pymodel":
+        eng = PyRateLimiter(clock=frozen_clock)
+        yield eng
+    else:
+        cfg = DeviceConfig(num_slots=1024, ways=8, batch_size=64)
+        yield DeviceBackend(cfg, clock=frozen_clock)
+
+
+def check(engine, req):
+    if isinstance(engine, PyRateLimiter):
+        return engine.get_rate_limit(req)
+    return engine.check([req])[0]
+
+
+def test_token_bucket(engine, frozen_clock):
+    # functional_test.go:159-217
+    cases = [
+        (1, UNDER, 0),
+        (0, UNDER, 100),
+        (1, UNDER, 0),
+    ]
+    for remaining, status, sleep_ms in cases:
+        rl = check(
+            engine,
+            RateLimitReq(
+                name="test_token_bucket",
+                unique_key="account:1234",
+                algorithm=Algorithm.TOKEN_BUCKET,
+                duration=5,
+                limit=2,
+                hits=1,
+            ),
+        )
+        assert rl.error == ""
+        assert rl.status == status
+        assert rl.remaining == remaining
+        assert rl.limit == 2
+        assert rl.reset_time != 0
+        frozen_clock.advance(sleep_ms)
+
+
+def test_token_bucket_gregorian(engine, frozen_clock):
+    # functional_test.go:220-292
+    cases = [
+        (1, 59, UNDER, 0),
+        (1, 58, UNDER, 0),
+        (58, 0, UNDER, 0),
+        (1, 0, OVER, 61 * SECOND),
+        (0, 60, UNDER, 0),
+    ]
+    for hits, remaining, status, sleep_ms in cases:
+        rl = check(
+            engine,
+            RateLimitReq(
+                name="test_token_bucket_greg",
+                unique_key="account:12345",
+                behavior=Behavior.DURATION_IS_GREGORIAN,
+                algorithm=Algorithm.TOKEN_BUCKET,
+                duration=GREGORIAN_MINUTES,
+                hits=hits,
+                limit=60,
+            ),
+        )
+        assert rl.error == ""
+        assert rl.status == status, f"hits={hits}"
+        assert rl.remaining == remaining
+        assert rl.limit == 60
+        assert rl.reset_time != 0
+        frozen_clock.advance(sleep_ms)
+
+
+def test_token_bucket_negative_hits(engine, frozen_clock):
+    # functional_test.go:295-365: negative hits add back to remaining,
+    # even beyond the limit (no clamp on subtraction).
+    def req(hits):
+        return RateLimitReq(
+            name="test_token_bucket_negative",
+            unique_key="account:12345",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=5,
+            hits=hits,
+            limit=2,
+        )
+
+    cases = [(-1, 3, UNDER), (-1, 4, UNDER), (4, 0, UNDER), (-1, 1, UNDER)]
+    for hits, remaining, status in cases:
+        rl = check(engine, req(hits))
+        assert rl.error == ""
+        assert rl.status == status, f"hits={hits}"
+        assert rl.remaining == remaining, f"hits={hits}"
+        assert rl.limit == 2
+        assert rl.reset_time != 0
+
+
+def test_leaky_bucket(engine, frozen_clock):
+    # functional_test.go:367-492: duration 30s, limit 10 -> rate 3000ms/token.
+    cases = [
+        (1, 9, UNDER, 1 * SECOND),
+        (1, 8, UNDER, 1 * SECOND),
+        (1, 7, UNDER, 1500),
+        (0, 8, UNDER, 3 * SECOND),
+        (0, 9, UNDER, 0),
+        (9, 0, UNDER, 0),
+        (1, 0, OVER, 3 * SECOND),
+        (0, 1, UNDER, 60 * SECOND),
+        (0, 10, UNDER, 60 * SECOND),
+        (10, 0, UNDER, 29 * SECOND),
+        (9, 0, UNDER, 3 * SECOND),
+        (1, 0, UNDER, 1 * SECOND),
+    ]
+    for i, (hits, remaining, status, sleep_ms) in enumerate(cases):
+        rl = check(
+            engine,
+            RateLimitReq(
+                name="test_leaky_bucket",
+                unique_key="account:1234",
+                algorithm=Algorithm.LEAKY_BUCKET,
+                duration=30 * SECOND,
+                hits=hits,
+                limit=10,
+            ),
+        )
+        assert rl.status == status, f"case {i}"
+        assert rl.remaining == remaining, f"case {i}"
+        assert rl.limit == 10
+        # ResetTime = now + (limit-remaining)*rate (functional_test.go:484)
+        now_s = frozen_clock.millisecond_now() // 1000
+        assert rl.reset_time // 1000 == now_s + (10 - rl.remaining) * 3
+        frozen_clock.advance(sleep_ms)
+
+
+def test_leaky_bucket_with_burst(engine, frozen_clock):
+    # functional_test.go:494+: burst 20, limit 10, duration 30s.
+    def req(hits):
+        return RateLimitReq(
+            name="test_leaky_bucket_burst",
+            unique_key="account:1234",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=30 * SECOND,
+            hits=hits,
+            limit=10,
+            burst=20,
+        )
+
+    assert check(engine, req(1)).remaining == 19
+    frozen_clock.advance(1 * SECOND)
+    assert check(engine, req(1)).remaining == 18
+    # Burst capacity caps refill at 20.
+    frozen_clock.advance(120 * SECOND)
+    assert check(engine, req(0)).remaining == 20
+
+
+def test_change_limit(engine, frozen_clock):
+    # functional_test.go:870-962.
+    cases = [
+        (Algorithm.TOKEN_BUCKET, 100, 99),
+        (Algorithm.TOKEN_BUCKET, 100, 98),
+        (Algorithm.TOKEN_BUCKET, 10, 7),
+        (Algorithm.TOKEN_BUCKET, 10, 6),
+        (Algorithm.TOKEN_BUCKET, 200, 195),
+        (Algorithm.LEAKY_BUCKET, 100, 99),
+        (Algorithm.LEAKY_BUCKET, 10, 9),
+        (Algorithm.LEAKY_BUCKET, 10, 8),
+    ]
+    for i, (algo, limit, remaining) in enumerate(cases):
+        rl = check(
+            engine,
+            RateLimitReq(
+                name=f"test_change_limit_{algo.name}",
+                unique_key="account:1234",
+                algorithm=algo,
+                duration=9000,
+                limit=limit,
+                hits=1,
+            ),
+        )
+        assert rl.status == UNDER, f"case {i}"
+        assert rl.remaining == remaining, f"case {i}"
+        assert rl.limit == limit, f"case {i}"
+        assert rl.reset_time != 0
+
+
+def test_reset_remaining(engine, frozen_clock):
+    # functional_test.go:965-1035.
+    def req(behavior):
+        return RateLimitReq(
+            name="test_reset_remaining",
+            unique_key="account:1234",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=MINUTE,
+            limit=100,
+            hits=1,
+            behavior=behavior,
+        )
+
+    assert check(engine, req(Behavior.BATCHING)).remaining == 99
+    assert check(engine, req(Behavior.BATCHING)).remaining == 98
+    rl = check(engine, req(Behavior.RESET_REMAINING))
+    assert rl.remaining == 100 and rl.status == UNDER
+    assert check(engine, req(Behavior.BATCHING)).remaining == 99
+
+
+def test_leaky_bucket_div_bug(engine, frozen_clock):
+    # functional_test.go:1106-1147: rate = 1000/2000 = 0.5ms/token must not
+    # floor to zero in the remaining arithmetic.
+    def req(hits):
+        return RateLimitReq(
+            name="test_leaky_bucket_div",
+            unique_key="account:12345",
+            algorithm=Algorithm.LEAKY_BUCKET,
+            duration=1000,
+            hits=hits,
+            limit=2000,
+        )
+
+    rl = check(engine, req(1))
+    assert rl.error == ""
+    assert rl.status == UNDER
+    assert rl.remaining == 1999
+    assert rl.limit == 2000
+    rl = check(engine, req(100))
+    assert rl.remaining == 1899
+    assert rl.limit == 2000
+
+
+def test_token_bucket_over_limit_first_hit(engine, frozen_clock):
+    # algorithms.go:243-249: hits > limit on a fresh key.
+    rl = check(
+        engine,
+        RateLimitReq(
+            name="test_over_first",
+            unique_key="k",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            duration=MINUTE,
+            limit=10,
+            hits=100,
+        ),
+    )
+    assert rl.status == OVER
+    assert rl.remaining == 10
+
+
+def test_validation_errors(frozen_clock):
+    be = DeviceBackend(DeviceConfig(num_slots=256, ways=8, batch_size=16))
+    resps = be.check(
+        [
+            RateLimitReq(name="", unique_key="k", limit=1, hits=1),
+            RateLimitReq(name="n", unique_key="", limit=1, hits=1),
+            RateLimitReq(name="n", unique_key="k", limit=5, hits=1, duration=1000),
+        ]
+    )
+    assert "name" in resps[0].error
+    assert "unique_key" in resps[1].error
+    assert resps[2].error == "" and resps[2].remaining == 4
+
+
+def test_duplicate_keys_in_batch(frozen_clock):
+    # Duplicates must be applied sequentially (packer rounds).
+    be = DeviceBackend(DeviceConfig(num_slots=256, ways=8, batch_size=16))
+    reqs = [
+        RateLimitReq(
+            name="dup", unique_key="k", limit=10, hits=1, duration=MINUTE
+        )
+        for _ in range(5)
+    ]
+    resps = be.check(reqs)
+    assert [r.remaining for r in resps] == [9, 8, 7, 6, 5]
+
+
+def test_duplicate_keys_batch_overflow(frozen_clock):
+    # Round overflow must never put two occurrences of one key in the same
+    # round, and must preserve per-key occurrence order.
+    be = DeviceBackend(DeviceConfig(num_slots=256, ways=8, batch_size=2))
+    reqs = [
+        RateLimitReq(name="of", unique_key="a", limit=10, hits=1, duration=MINUTE),
+        RateLimitReq(name="of", unique_key="b", limit=10, hits=1, duration=MINUTE),
+        RateLimitReq(name="of", unique_key="c", limit=10, hits=1, duration=MINUTE),
+        RateLimitReq(name="of", unique_key="c", limit=10, hits=1, duration=MINUTE),
+        RateLimitReq(name="of", unique_key="c", limit=10, hits=1, duration=MINUTE),
+    ]
+    resps = be.check(reqs)
+    assert [r.remaining for r in resps] == [9, 9, 9, 8, 7]
+
+
+def test_get_cache_item(frozen_clock):
+    be = DeviceBackend(DeviceConfig(num_slots=256, ways=8, batch_size=16))
+    be.check(
+        [RateLimitReq(name="gci", unique_key="k", limit=10, hits=3, duration=MINUTE)]
+    )
+    item = be.get_cache_item("gci_k")
+    assert item is not None
+    assert item.limit == 10 and item.remaining == 7
+    assert be.get_cache_item("gci_missing") is None

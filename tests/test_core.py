@@ -1,0 +1,145 @@
+"""Core-layer tests: types, clock, Gregorian intervals, config, hashing."""
+import os
+from datetime import datetime, timezone
+
+import pytest
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import (
+    BehaviorConfig,
+    DeviceConfig,
+    parse_duration_s,
+    setup_daemon_config,
+)
+from gubernator_tpu.core.hashing import bulk_key_hash64, fnv1_64, fnv1a_64, key_hash64
+from gubernator_tpu.core.interval import (
+    GREGORIAN_DAYS,
+    GREGORIAN_HOURS,
+    GREGORIAN_MINUTES,
+    GREGORIAN_MONTHS,
+    GREGORIAN_WEEKS,
+    GREGORIAN_YEARS,
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.core.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    has_behavior,
+)
+
+
+def test_hash_key():
+    r = RateLimitReq(name="test_over_limit", unique_key="acct:1234")
+    assert r.hash_key() == "test_over_limit_acct:1234"
+
+
+def test_behavior_flags():
+    b = Behavior.GLOBAL | Behavior.RESET_REMAINING
+    assert has_behavior(b, Behavior.GLOBAL)
+    assert has_behavior(b, Behavior.RESET_REMAINING)
+    assert not has_behavior(b, Behavior.NO_BATCHING)
+    # BATCHING is the zero value: has_behavior always False (gubernator.go:786)
+    assert not has_behavior(b, Behavior.BATCHING)
+
+
+def test_clock_freeze_advance():
+    clk = clock_mod.Clock()
+    clk.freeze()
+    t0 = clk.millisecond_now()
+    clk.advance(1500)
+    assert clk.millisecond_now() == t0 + 1500
+    clk.unfreeze()
+    assert not clk.frozen
+
+
+# Mirrors interval_test.go:66-137 expectations.
+@pytest.mark.parametrize(
+    "d,now,expect",
+    [
+        (
+            GREGORIAN_MINUTES,
+            datetime(2019, 1, 1, 11, 20, 10, tzinfo=timezone.utc),
+            datetime(2019, 1, 1, 11, 20, 59, 999000, tzinfo=timezone.utc),
+        ),
+        (
+            GREGORIAN_HOURS,
+            datetime(2019, 1, 1, 11, 20, 10, tzinfo=timezone.utc),
+            datetime(2019, 1, 1, 11, 59, 59, 999000, tzinfo=timezone.utc),
+        ),
+        (
+            GREGORIAN_DAYS,
+            datetime(2019, 1, 1, 11, 20, 10, tzinfo=timezone.utc),
+            datetime(2019, 1, 1, 23, 59, 59, 999000, tzinfo=timezone.utc),
+        ),
+        (
+            GREGORIAN_MONTHS,
+            datetime(2019, 1, 15, 11, 20, 10, tzinfo=timezone.utc),
+            datetime(2019, 1, 31, 23, 59, 59, 999000, tzinfo=timezone.utc),
+        ),
+        (
+            GREGORIAN_YEARS,
+            datetime(2019, 6, 15, 11, 20, 10, tzinfo=timezone.utc),
+            datetime(2019, 12, 31, 23, 59, 59, 999000, tzinfo=timezone.utc),
+        ),
+    ],
+)
+def test_gregorian_expiration(d, now, expect):
+    got = gregorian_expiration(now, d)
+    assert got == int(expect.timestamp() * 1000)
+
+
+def test_gregorian_invalid():
+    now = datetime(2019, 1, 1, tzinfo=timezone.utc)
+    with pytest.raises(GregorianError):
+        gregorian_expiration(now, 99)
+    with pytest.raises(GregorianError):
+        gregorian_expiration(now, GREGORIAN_WEEKS)
+    with pytest.raises(GregorianError):
+        gregorian_duration(now, GREGORIAN_WEEKS)
+
+
+def test_gregorian_duration_values():
+    now = datetime(2019, 2, 10, tzinfo=timezone.utc)
+    assert gregorian_duration(now, GREGORIAN_MINUTES) == 60_000
+    assert gregorian_duration(now, GREGORIAN_HOURS) == 3_600_000
+    assert gregorian_duration(now, GREGORIAN_DAYS) == 86_400_000
+    assert gregorian_duration(now, GREGORIAN_MONTHS) == 28 * 86_400_000
+    assert gregorian_duration(now, GREGORIAN_YEARS) == 365 * 86_400_000
+
+
+def test_parse_duration():
+    assert parse_duration_s("500us") == pytest.approx(500e-6)
+    assert parse_duration_s("500ms") == pytest.approx(0.5)
+    assert parse_duration_s("2s") == pytest.approx(2.0)
+    assert parse_duration_s("0.25") == pytest.approx(0.25)
+
+
+def test_env_config(monkeypatch):
+    monkeypatch.setenv("GUBER_GRPC_ADDRESS", "0.0.0.0:9990")
+    monkeypatch.setenv("GUBER_BATCH_LIMIT", "250")
+    monkeypatch.setenv("GUBER_BATCH_WAIT", "250us")
+    monkeypatch.setenv("GUBER_PEERS", "a:1051, b:1051")
+    cfg = setup_daemon_config()
+    assert cfg.grpc_listen_address == "0.0.0.0:9990"
+    assert cfg.behaviors.batch_limit == 250
+    assert cfg.behaviors.batch_wait_s == pytest.approx(250e-6)
+    assert cfg.static_peers == ["a:1051", "b:1051"]
+    assert cfg.peer_discovery_type == "static"
+
+
+def test_device_config_validation():
+    with pytest.raises(ValueError):
+        DeviceConfig(num_slots=100, ways=8)
+
+
+def test_hashing():
+    # FNV test vectors (same constants as segmentio/fasthash).
+    assert fnv1a_64(b"") == 0xCBF29CE484222325
+    assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1_64(b"a") == 0xAF63BD4C8601B7BE
+    assert key_hash64("foo_bar") != 0
+    hs = bulk_key_hash64(["a_1", "a_2", "a_1"])
+    assert hs[0] == hs[2] != hs[1]

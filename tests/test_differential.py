@@ -1,0 +1,102 @@
+"""Differential fuzzing: device kernel vs sequential oracle.
+
+Drives randomized op streams (mixed algorithms, limit/duration changes,
+resets, negative hits, time advances, duplicate keys) through both the
+vectorized device step and the exact sequential model; every response must
+match bit-for-bit while no evictions occur (table sized to hold the whole
+key space).
+
+This is the TPU analog of the reference's algorithm test tiers — instead of
+goroutine-race coverage (`go test -race`), correctness-under-vectorization is
+the thing to prove (SURVEY.md §7 "hard parts").
+"""
+import random
+
+import pytest
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import DeviceConfig
+from gubernator_tpu.core.pymodel import PyRateLimiter
+from gubernator_tpu.core.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+)
+from gubernator_tpu.runtime.backend import DeviceBackend
+
+
+def _random_req(rng: random.Random, n_keys: int) -> RateLimitReq:
+    algo = rng.choice([Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET])
+    behavior = Behavior.BATCHING
+    if rng.random() < 0.05:
+        behavior |= Behavior.RESET_REMAINING
+    if rng.random() < 0.10:
+        behavior |= Behavior.DURATION_IS_GREGORIAN
+    hits = rng.choice([0, 1, 1, 1, 2, 5, -1, 100])
+    limit = rng.choice([0, 1, 2, 10, 100, 2000])
+    if behavior & Behavior.DURATION_IS_GREGORIAN:
+        duration = rng.choice([0, 1, 2])  # minutes/hours/days
+    else:
+        duration = rng.choice([5, 1000, 30_000, 60_000])
+    burst = rng.choice([0, 0, 0, 20])
+    return RateLimitReq(
+        name=f"diff_{rng.randrange(4)}",
+        unique_key=f"k:{rng.randrange(n_keys)}",
+        algorithm=algo,
+        behavior=behavior,
+        hits=hits,
+        limit=limit,
+        duration=duration,
+        burst=burst,
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_differential_random_stream(seed, frozen_clock):
+    rng = random.Random(seed)
+    n_keys = 40  # 4 names x 40 keys = up to 160 distinct hash keys
+    oracle = PyRateLimiter(clock=frozen_clock)
+    device = DeviceBackend(
+        DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        clock=frozen_clock,
+    )
+
+    for step in range(60):
+        batch = [_random_req(rng, n_keys) for _ in range(rng.randrange(1, 48))]
+        dev_resps = device.check(batch)
+        for i, req in enumerate(batch):
+            want = oracle.get_rate_limit(req)
+            got = dev_resps[i]
+            ctx = f"step={step} i={i} req={req}"
+            assert got.status == want.status, ctx
+            assert got.remaining == want.remaining, ctx
+            assert got.limit == want.limit, ctx
+            assert got.reset_time == want.reset_time, ctx
+        # Random time advance, including past expiries.
+        frozen_clock.advance(rng.choice([0, 1, 500, 3_000, 61_000]))
+
+
+def test_eviction_under_pressure(frozen_clock):
+    """Tiny table, many keys: decisions must stay sane (new-item semantics)
+    even when state is evicted — the acceptable-loss contract
+    (architecture.md:5-11)."""
+    device = DeviceBackend(
+        DeviceConfig(num_slots=32, ways=8, batch_size=64), clock=frozen_clock
+    )
+    for round_i in range(6):
+        reqs = [
+            RateLimitReq(
+                name="evict",
+                unique_key=f"k:{i}",
+                limit=10,
+                hits=1,
+                duration=60_000,
+            )
+            for i in range(round_i * 40, round_i * 40 + 40)
+        ]
+        resps = device.check(reqs)
+        for r in resps:
+            assert r.error == ""
+            assert r.remaining == 9  # all fresh keys
+    occ = device.occupancy()
+    assert occ <= 32
