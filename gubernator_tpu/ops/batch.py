@@ -51,39 +51,56 @@ class DeviceBatch(NamedTuple):
 
 
 @dataclass
+class PackedGrid:
+    """Requests packed into [n_shards, batch_size] rounds."""
+
+    rounds: List[DeviceBatch]  # arrays are [n_shards, batch_size]
+    # For each original request i: (round, shard, lane); (-1,-1,-1) = errored.
+    positions: List[Tuple[int, int, int]]
+    errors: Dict[int, str]  # request index -> validation error
+
+
+@dataclass
 class PackedRounds:
     """One device batch split into sequential rounds for duplicate keys."""
 
-    rounds: List[DeviceBatch]
+    rounds: List[DeviceBatch]  # arrays are [batch_size]
     # For each original request i: (round_index, lane_index).
     positions: List[Tuple[int, int]]
     errors: Dict[int, str]  # request index -> validation error
 
 
-def pack_requests(
+def pack_requests_grid(
     reqs: Sequence[RateLimitReq],
     batch_size: int,
+    n_shards: int,
+    shard_fn,
     clock: Optional[clock_mod.Clock] = None,
-) -> PackedRounds:
-    """Pack requests into rounds of fixed-shape [batch_size] arrays.
+) -> PackedGrid:
+    """Pack requests into rounds of fixed-shape [n_shards, batch_size] arrays.
+
+    `shard_fn(hash_key) -> int` routes each key to its owning shard (the
+    worker-pool hash range / peer ring analog, workers.go:182-186).
 
     Validation mirrors gubernator.go:228-237 (empty name / unique_key) plus
     Gregorian interval validation (interval.go:107,147) — failed requests get
     an error entry and no lane.
+
+    Invariants: a key appears at most once per round (the kernel's unique-key
+    contract), and occurrence k of a key lands in a strictly later round than
+    occurrence k-1 (so same-key requests observe each other's effects in
+    order, like the reference's per-key worker serialization).
     """
     clock = clock or clock_mod.default_clock()
     now_dt = clock.now()
 
-    positions: List[Tuple[int, int]] = [(-1, -1)] * len(reqs)
+    positions: List[Tuple[int, int, int]] = [(-1, -1, -1)] * len(reqs)
     errors: Dict[int, str] = {}
 
-    # Assign each request to (round, lane).  Invariants: a key appears at
-    # most once per round (the kernel's unique-key contract), and occurrence
-    # k of a key lands in a strictly later round than occurrence k-1 (so
-    # same-key requests observe each other's effects in order).
     last_round: Dict[str, int] = {}
     round_keys: List[set] = []
-    per_round: List[List[Tuple[int, RateLimitReq]]] = []
+    per_round: List[List[List[Tuple[int, RateLimitReq]]]] = []
+    shard_cache: Dict[str, int] = {}
     for i, r in enumerate(reqs):
         if not r.name:
             errors[i] = "field 'name' cannot be empty"
@@ -92,31 +109,63 @@ def pack_requests(
             errors[i] = "field 'unique_key' cannot be empty"
             continue
         key = r.hash_key()
+        shard = shard_cache.get(key)
+        if shard is None:
+            shard = shard_fn(key)
+            shard_cache[key] = shard
         rnd = last_round.get(key, -1) + 1
         while True:
             if rnd >= len(per_round):
-                per_round.append([])
+                per_round.append([[] for _ in range(n_shards)])
                 round_keys.append(set())
-            if len(per_round[rnd]) < batch_size and key not in round_keys[rnd]:
+            if (
+                len(per_round[rnd][shard]) < batch_size
+                and key not in round_keys[rnd]
+            ):
                 break
             rnd += 1
         last_round[key] = rnd
         round_keys[rnd].add(key)
-        per_round[rnd].append((i, r))
+        per_round[rnd][shard].append((i, r))
 
     rounds: List[DeviceBatch] = []
-    for rnd_idx, entries in enumerate(per_round):
-        b = _empty_batch(batch_size)
-        for lane, (i, r) in enumerate(entries):
-            positions[i] = (rnd_idx, lane)
-            err = _fill_lane(b, lane, r, now_dt)
-            if err is not None:
-                errors[i] = err
-                positions[i] = (-1, -1)
-                _clear_lane(b, lane)
-        rounds.append(b)
+    for rnd_idx, shards in enumerate(per_round):
+        batches = [_empty_batch(batch_size) for _ in range(n_shards)]
+        for shard, entries in enumerate(shards):
+            for lane, (i, r) in enumerate(entries):
+                positions[i] = (rnd_idx, shard, lane)
+                err = _fill_lane(batches[shard], lane, r, now_dt)
+                if err is not None:
+                    errors[i] = err
+                    positions[i] = (-1, -1, -1)
+                    _clear_lane(batches[shard], lane)
+        rounds.append(
+            DeviceBatch(
+                *[
+                    np.stack([getattr(b, f) for b in batches])
+                    for f in DeviceBatch._fields
+                ]
+            )
+        )
 
-    return PackedRounds(rounds=rounds, positions=positions, errors=errors)
+    return PackedGrid(rounds=rounds, positions=positions, errors=errors)
+
+
+def pack_requests(
+    reqs: Sequence[RateLimitReq],
+    batch_size: int,
+    clock: Optional[clock_mod.Clock] = None,
+) -> PackedRounds:
+    """Single-shard packing: the n_shards=1 view of pack_requests_grid."""
+    grid = pack_requests_grid(reqs, batch_size, 1, lambda key: 0, clock)
+    return PackedRounds(
+        rounds=[DeviceBatch(*[a[0] for a in rb]) for rb in grid.rounds],
+        positions=[
+            (rnd, lane) if rnd >= 0 else (-1, -1)
+            for (rnd, _, lane) in grid.positions
+        ],
+        errors=grid.errors,
+    )
 
 
 def _empty_batch(batch_size: int) -> DeviceBatch:

@@ -105,14 +105,17 @@ def _member_of(sorted_vals: jax.Array, queries: jax.Array) -> jax.Array:
     return sorted_vals[pos] == queries
 
 
-@functools.partial(jax.jit, static_argnames=("ways",), donate_argnums=(0,))
-def apply_batch(
+def apply_batch_impl(
     table: SlotTable,
     batch: DeviceBatchJ,
     now: jax.Array,
     ways: int = 8,
 ) -> Tuple[SlotTable, Resp]:
-    """Apply one padded batch; returns (new_table, responses)."""
+    """Apply one padded batch; returns (new_table, responses).
+
+    Un-jitted traceable core — call `apply_batch` directly, or wrap this in
+    `shard_map` for the mesh-sharded table (gubernator_tpu.parallel).
+    """
     S = table.key.shape[0]
     nb = S // ways
     if nb & (nb - 1):
@@ -347,3 +350,8 @@ def apply_batch(
         touched=scat(table.touched, n_touched),
     )
     return new_table, resp
+
+
+apply_batch = jax.jit(
+    apply_batch_impl, static_argnames=("ways",), donate_argnums=(0,)
+)

@@ -1,0 +1,2 @@
+from gubernator_tpu.parallel.mesh import make_mesh, shard_of_hash  # noqa: F401
+from gubernator_tpu.parallel.sharded import MeshBackend  # noqa: F401

@@ -1,0 +1,171 @@
+"""Mesh-sharded slot table: the multi-chip engine.
+
+The slot table's slot axis is sharded over the mesh `shard` axis
+(`NamedSharding(mesh, P("shard"))`); each device owns `num_slots/n` slots and
+is the single writer for the keys that hash to it — the same
+single-writer-by-placement discipline as the reference worker pool
+(workers.go:19-37) and peer ring (architecture.md:13-17), enforced here by
+data placement instead of goroutine ownership.
+
+One jitted `shard_map` step applies a [n_shards, batch_size] request block:
+each device runs the same branchless kernel (ops/step.py) on its local shard.
+The hot path needs NO collectives — routing already placed every request on
+its owner — which is exactly why the table is sharded on hash bits rather
+than consistent-hashed: placement is static, so the "network hop" of the
+reference (peer_client.go) compiles away to local work on the right device.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import gubernator_tpu.ops  # noqa: F401  (enables x64)
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import DeviceConfig
+from gubernator_tpu.core.hashing import key_hash64
+from gubernator_tpu.core.types import CacheItem, RateLimitReq, RateLimitResp
+from gubernator_tpu.ops.batch import PackedGrid, pack_requests_grid
+from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
+from gubernator_tpu.ops.step import DeviceBatchJ, Resp, apply_batch_impl
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_of_hash
+from gubernator_tpu.runtime.backend import (
+    _row_to_item,
+    resp_rounds_to_host,
+    unmarshal_responses,
+)
+
+
+def pack_requests_sharded(
+    reqs: Sequence[RateLimitReq],
+    batch_size: int,
+    n_shards: int,
+    clock: Optional[clock_mod.Clock] = None,
+) -> PackedGrid:
+    """Route each request to its owning shard and pack per-shard lanes.
+
+    Same contract as ops.batch.pack_requests (validation, duplicate-key
+    rounds) with one more coordinate: the shard.  A key's occurrences are
+    serialized across rounds; capacity is batch_size lanes per (round, shard).
+    """
+    return pack_requests_grid(
+        reqs,
+        batch_size,
+        n_shards,
+        lambda key: int(shard_of_hash(key_hash64(key), n_shards)),
+        clock,
+    )
+
+
+def make_sharded_step(mesh, ways: int):
+    """Build the jitted multi-device step: table'[n·S], resp[n,B] =
+    step(table[n·S], batch[n,B], now)."""
+
+    def _local(table: SlotTable, batch: DeviceBatchJ, now):
+        b = DeviceBatchJ(*[a[0] for a in batch])
+        t2, r = apply_batch_impl(table, b, now, ways=ways)
+        return t2, Resp(*[a[None] for a in r])
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+class MeshBackend:
+    """Drop-in peer of runtime.backend.DeviceBackend over a device mesh."""
+
+    def __init__(
+        self,
+        cfg: DeviceConfig,
+        clock: Optional[clock_mod.Clock] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        if cfg.num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.cfg = cfg
+        self.clock = clock or clock_mod.default_clock()
+        self._lock = threading.Lock()
+        self.mesh = make_mesh(cfg.num_shards, devices)
+        self.local_slots = cfg.num_slots // cfg.num_shards
+        nb_local = self.local_slots // cfg.ways
+        if nb_local & (nb_local - 1):
+            raise ValueError(
+                f"buckets per shard ({nb_local}) must be a power of two"
+            )
+        self._tsharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self._bsharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self.table: SlotTable = jax.device_put(
+            init_table(cfg.num_slots), self._tsharding
+        )
+        self._step = make_sharded_step(self.mesh, cfg.ways)
+        self.checks = 0
+        self.over_limit = 0
+        self.not_persisted = 0
+
+    def _add_tally(self, tally) -> None:
+        with self._lock:
+            self.checks += tally.checks
+            self.over_limit += tally.over_limit
+            self.not_persisted += tally.not_persisted
+
+    # -- hot path --------------------------------------------------------
+    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        packed = pack_requests_sharded(
+            reqs, self.cfg.batch_size, self.cfg.num_shards, self.clock
+        )
+        now = np.int64(self.clock.millisecond_now())
+
+        round_resps = []
+        with self._lock:
+            for db in packed.rounds:
+                batch = DeviceBatchJ(
+                    *[jax.device_put(a, self._bsharding) for a in db]
+                )
+                self.table, resp = self._step(self.table, batch, now)
+                round_resps.append(resp)
+        out, tally = unmarshal_responses(
+            len(reqs), packed.errors, packed.positions,
+            resp_rounds_to_host(round_resps),
+        )
+        self._add_tally(tally)
+        return out
+
+    # -- point reads / persistence ---------------------------------------
+    def get_cache_item(self, key: str) -> Optional[CacheItem]:
+        h64 = key_hash64(key)
+        h = int(np.uint64(h64).view(np.int64))
+        shard = int(shard_of_hash(h64, self.cfg.num_shards))
+        nb_local = self.local_slots // self.cfg.ways
+        bucket = h64 & (nb_local - 1)
+        lo = shard * self.local_slots + bucket * self.cfg.ways
+        hi = lo + self.cfg.ways
+        with self._lock:
+            rows = {
+                f: np.asarray(getattr(self.table, f)[lo:hi])
+                for f in self.table._fields
+            }
+        now = self.clock.millisecond_now()
+        for w in range(self.cfg.ways):
+            if rows["key"][w] == h and rows["expire_at"][w] > now:
+                return _row_to_item(rows, w, key)
+        return None
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        with self._lock:
+            return table_to_host(self.table)
+
+    def occupancy(self) -> int:
+        with self._lock:
+            return int(np.asarray(self.table.occupancy()))

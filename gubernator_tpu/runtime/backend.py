@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -58,6 +58,12 @@ class DeviceBackend:
         self.over_limit = 0
         self.not_persisted = 0
 
+    def _add_tally(self, tally: "Tally") -> None:
+        with self._lock:
+            self.checks += tally.checks
+            self.over_limit += tally.over_limit
+            self.not_persisted += tally.not_persisted
+
     # -- hot path --------------------------------------------------------
     def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
         """Apply a list of checks; returns responses in request order.
@@ -68,7 +74,6 @@ class DeviceBackend:
         """
         packed = pack_requests(reqs, self.cfg.batch_size, self.clock)
         now = self.clock.millisecond_now()
-        out: List[Optional[RateLimitResp]] = [None] * len(reqs)
 
         round_resps = []
         with self._lock:
@@ -78,36 +83,12 @@ class DeviceBackend:
                 )
                 round_resps.append(resp)
         # One sync at the end of all rounds.
-        round_host = [
-            {
-                "status": np.asarray(r.status),
-                "remaining": np.asarray(r.remaining),
-                "reset_time": np.asarray(r.reset_time),
-                "limit": np.asarray(r.limit),
-                "persisted": np.asarray(r.persisted),
-            }
-            for r in round_resps
-        ]
-
-        for i in range(len(reqs)):
-            err = packed.errors.get(i)
-            if err is not None:
-                out[i] = RateLimitResp(error=err)
-                continue
-            rnd, lane = packed.positions[i]
-            r = round_host[rnd]
-            out[i] = RateLimitResp(
-                status=Status(int(r["status"][lane])),
-                limit=int(r["limit"][lane]),
-                remaining=int(r["remaining"][lane]),
-                reset_time=int(r["reset_time"][lane]),
-            )
-            self.checks += 1
-            if out[i].status == Status.OVER_LIMIT:
-                self.over_limit += 1
-            if not r["persisted"][lane]:
-                self.not_persisted += 1
-        return out  # type: ignore[return-value]
+        out, tally = unmarshal_responses(
+            len(reqs), packed.errors, packed.positions,
+            resp_rounds_to_host(round_resps),
+        )
+        self._add_tally(tally)
+        return out
 
     # -- cache item access (GLOBAL path + persistence SPI) ---------------
     def get_cache_item(self, key: str) -> Optional[CacheItem]:
@@ -137,6 +118,65 @@ class DeviceBackend:
     def occupancy(self) -> int:
         with self._lock:
             return int(np.asarray(self.table.occupancy()))
+
+
+class Tally(NamedTuple):
+    """Per-call metric increments (gubernator.go:59-113 counters)."""
+
+    checks: int
+    over_limit: int
+    not_persisted: int
+
+
+def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
+    """DMA one list of device Resp rounds to host numpy dicts (single sync)."""
+    return [
+        {
+            "status": np.asarray(r.status),
+            "remaining": np.asarray(r.remaining),
+            "reset_time": np.asarray(r.reset_time),
+            "limit": np.asarray(r.limit),
+            "persisted": np.asarray(r.persisted),
+        }
+        for r in round_resps
+    ]
+
+
+def unmarshal_responses(
+    n_reqs: int,
+    errors: Dict[int, str],
+    positions: Sequence[tuple],
+    round_host: List[Dict[str, np.ndarray]],
+) -> tuple:
+    """Build per-request RateLimitResp from packed positions.
+
+    `positions[i]` is (round, *index) where *index indexes the response
+    arrays directly — (lane,) for the single-table backend, (shard, lane)
+    for the mesh backend.  Returns (responses, Tally).
+    """
+    out: List[RateLimitResp] = []
+    checks = over = notp = 0
+    for i in range(n_reqs):
+        err = errors.get(i)
+        if err is not None:
+            out.append(RateLimitResp(error=err))
+            continue
+        rnd, *idx_l = positions[i]
+        idx = tuple(idx_l)
+        r = round_host[rnd]
+        resp = RateLimitResp(
+            status=Status(int(r["status"][idx])),
+            limit=int(r["limit"][idx]),
+            remaining=int(r["remaining"][idx]),
+            reset_time=int(r["reset_time"][idx]),
+        )
+        out.append(resp)
+        checks += 1
+        if resp.status == Status.OVER_LIMIT:
+            over += 1
+        if not r["persisted"][idx]:
+            notp += 1
+    return out, Tally(checks, over, notp)
 
 
 def _to_device(db: DeviceBatch) -> DeviceBatchJ:

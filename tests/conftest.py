@@ -13,6 +13,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon sitecustomize registers the TPU plugin at interpreter start and
+# pins jax_platforms before this conftest runs; override the config directly
+# (must happen before any backend is initialized).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from gubernator_tpu.core import clock as clock_mod  # noqa: E402
