@@ -48,6 +48,7 @@ class DeviceBatch(NamedTuple):
     greg_expire: np.ndarray   # int64[B]; host-precomputed interval end
     greg_duration: np.ndarray  # int64[B]; host-precomputed full interval ms
     active: np.ndarray        # bool[B]; False on padding lanes
+    use_cached: np.ndarray    # bool[B]; GLOBAL read path (serve cached rows)
 
 
 @dataclass
@@ -182,6 +183,7 @@ def _empty_batch(batch_size: int) -> DeviceBatch:
         greg_expire=z64(),
         greg_duration=z64(),
         active=np.zeros(batch_size, dtype=bool),
+        use_cached=np.zeros(batch_size, dtype=bool),
     )
 
 

@@ -35,7 +35,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from gubernator_tpu.ops.state import KIND_BUCKET, SlotTable
+from gubernator_tpu.ops.state import KIND_BUCKET, KIND_CACHED_RESP, SlotTable
 
 ALGO_TOKEN = 0
 ALGO_LEAKY = 1
@@ -70,6 +70,11 @@ class DeviceBatchJ(NamedTuple):
     greg_expire: jax.Array
     greg_duration: jax.Array
     active: jax.Array
+    # GLOBAL read path (gubernator.go:434-447): lanes with use_cached set
+    # answer verbatim from a live KIND_CACHED_RESP row (the owner's broadcast
+    # status) without mutating it; on miss they fall through to the normal
+    # algorithm ("process the rate limit like we own it").
+    use_cached: jax.Array
 
 
 def _f64(x: jax.Array) -> jax.Array:
@@ -105,27 +110,25 @@ def _member_of(sorted_vals: jax.Array, queries: jax.Array) -> jax.Array:
     return sorted_vals[pos] == queries
 
 
-def apply_batch_impl(
+def locate_slots(
     table: SlotTable,
-    batch: DeviceBatchJ,
+    h: jax.Array,
+    active: jax.Array,
     now: jax.Array,
-    ways: int = 8,
-) -> Tuple[SlotTable, Resp]:
-    """Apply one padded batch; returns (new_table, responses).
+    ways: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Set-associative lookup + insert-victim claim for a batch of keys.
 
-    Un-jitted traceable core — call `apply_batch` directly, or wrap this in
-    `shard_map` for the mesh-sharded table (gubernator_tpu.parallel).
+    Returns (found, persist, slot, slot_safe): `found` lanes matched a live
+    slot at `slot`; `persist & ~found` lanes won an insert victim at `slot`;
+    `~persist` lanes could not claim a slot (transient).  Each active key
+    must appear at most once in the batch (the packer's contract).
     """
     S = table.key.shape[0]
     nb = S // ways
     if nb & (nb - 1):
         raise ValueError(f"num_buckets ({nb}) must be a power of two")
-    B = batch.key_hash.shape[0]
-    now = jnp.asarray(now, dtype=jnp.int64)
-
-    h = batch.key_hash
-    active = batch.active
-    lane = jnp.arange(B, dtype=jnp.int64)
+    B = h.shape[0]
 
     bucket = (h.astype(jnp.uint64) & jnp.uint64(nb - 1)).astype(jnp.int64)
     sidx = bucket[:, None] * ways + jnp.arange(ways, dtype=jnp.int64)[None, :]
@@ -176,6 +179,26 @@ def apply_batch_impl(
     persist = found | won
     slot = jnp.where(found, match_slot, jnp.where(won, insert_slot, 0))
     slot_safe = jnp.clip(slot, 0, S - 1)
+    return found, persist, slot, slot_safe
+
+
+def apply_batch_impl(
+    table: SlotTable,
+    batch: DeviceBatchJ,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[SlotTable, Resp]:
+    """Apply one padded batch; returns (new_table, responses).
+
+    Un-jitted traceable core — call `apply_batch` directly, or wrap this in
+    `shard_map` for the mesh-sharded table (gubernator_tpu.parallel).
+    """
+    S = table.key.shape[0]
+    now = jnp.asarray(now, dtype=jnp.int64)
+
+    h = batch.key_hash
+    active = batch.active
+    found, persist, slot, slot_safe = locate_slots(table, h, active, now, ways)
 
     # ---- gather current rows -------------------------------------------
     g = lambda a: a[slot_safe]
@@ -200,6 +223,10 @@ def apply_batch_impl(
     reset = batch.reset_remaining
 
     is_bucket_row = found & (s_kind == KIND_BUCKET)
+    # GLOBAL non-owner read (gubernator.go:434-447): a live cached broadcast
+    # row answers verbatim, no mutation.  Without use_cached, a cached row is
+    # treated like an algorithm-switch (overwritten via the new-item path).
+    cached_hit = found & (s_kind == KIND_CACHED_RESP) & batch.use_cached
     # Path selection (see module docstring):
     tok_clear = req_token & reset & found  # algorithms.go:78-90 (pre type check)
     tok_exist = req_token & ~reset & is_bucket_row & (s_algo == ALGO_TOKEN)
@@ -302,19 +329,32 @@ def apply_batch_impl(
         return jnp.where(tok_clear, clear, x)
 
     resp = Resp(
-        status=sel(
-            te_resp_status, tn_resp_status, le_resp_status, ln_resp_status,
-            UNDER,
+        status=jnp.where(
+            cached_hit,
+            s_status,
+            sel(
+                te_resp_status, tn_resp_status, le_resp_status, ln_resp_status,
+                UNDER,
+            ),
         ).astype(jnp.int32),
-        limit=jnp.where(active, r_lim, 0),
-        remaining=sel(te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem, r_lim),
-        reset_time=sel(te_resp_reset, tn_expire, le_resp_reset, ln_resp_reset, 0),
+        limit=jnp.where(cached_hit, s_limit, jnp.where(active, r_lim, 0)),
+        remaining=jnp.where(
+            cached_hit,
+            s_rem,
+            sel(te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem, r_lim),
+        ),
+        # Cached rows store ExpireAt = broadcast ResetTime (gubernator.go:466).
+        reset_time=jnp.where(
+            cached_hit,
+            s_expire,
+            sel(te_resp_reset, tn_expire, le_resp_reset, ln_resp_reset, 0),
+        ),
         persisted=persist & active,
         found=found,
     )
 
     # ==== write back ====================================================
-    do_write = persist & active
+    do_write = persist & active & ~cached_hit
     tgt = jnp.where(do_write, slot, S)  # S -> dropped by scatter mode
 
     n_key = jnp.where(tok_clear, 0, h)
@@ -355,3 +395,56 @@ def apply_batch_impl(
 apply_batch = jax.jit(
     apply_batch_impl, static_argnames=("ways",), donate_argnums=(0,)
 )
+
+
+class CachedRows(NamedTuple):
+    """A batch of owner-broadcast statuses (UpdatePeerGlobal rows,
+    peers.proto:52-56): key fingerprint + the authoritative RateLimitResp."""
+
+    key_hash: jax.Array   # int64[B]; 0 = inactive lane
+    algo: jax.Array       # int32[B]
+    limit: jax.Array      # int64[B]
+    remaining: jax.Array  # int64[B]
+    status: jax.Array     # int32[B]
+    reset_time: jax.Array  # int64[B]
+
+
+def store_cached_rows_impl(
+    table: SlotTable,
+    rows: CachedRows,
+    now: jax.Array,
+    ways: int = 8,
+) -> SlotTable:
+    """Broadcast-receive: upsert KIND_CACHED_RESP rows into a cache table.
+
+    The device analog of UpdatePeerGlobals -> AddCacheItem
+    (gubernator.go:464-479): the stored item IS the response, with
+    ExpireAt = status.ResetTime.  Keys must be unique within the batch.
+    """
+    S = table.key.shape[0]
+    now = jnp.asarray(now, dtype=jnp.int64)
+    active = rows.key_hash != 0
+    found, persist, slot, _ = locate_slots(
+        table, rows.key_hash, active, now, ways
+    )
+    do_write = persist & active
+    tgt = jnp.where(do_write, slot, S)
+
+    def scat(arr, val):
+        return arr.at[tgt].set(val.astype(arr.dtype), mode="drop")
+
+    z = jnp.zeros_like(rows.key_hash)
+    return SlotTable(
+        key=scat(table.key, rows.key_hash),
+        algo=scat(table.algo, rows.algo),
+        kind=scat(table.kind, jnp.full_like(rows.algo, KIND_CACHED_RESP)),
+        limit=scat(table.limit, rows.limit),
+        duration=scat(table.duration, z),
+        remaining=scat(table.remaining, rows.remaining),
+        remaining_f=scat(table.remaining_f, z.astype(jnp.float64)),
+        t0=scat(table.t0, z),
+        status=scat(table.status, rows.status),
+        burst=scat(table.burst, z),
+        expire_at=scat(table.expire_at, rows.reset_time),
+        touched=scat(table.touched, jnp.full_like(rows.key_hash, now)),
+    )

@@ -1,0 +1,387 @@
+"""GLOBAL behavior on the mesh: hot-key replication with collective sync.
+
+Reference semantics (global.go:33-254, gubernator.go:420-479): a GLOBAL key
+is served from the local cache on ANY peer — a live owner-broadcast status
+answers verbatim; a miss is processed locally "like we own it" — while every
+hit is queued, aggregated by key, flushed to the owning peer, applied there,
+and the authoritative status broadcast back to all peers.  Stale-but-fast
+reads; owner-authoritative eventual consistency.
+
+TPU re-expression: devices are the peers.  Every device keeps a local CACHE
+table (replicated serving state — any device can answer any GLOBAL key, which
+is what lets a hot key scale past its owner's lanes); the authoritative state
+lives in the owner's shard of the AUTH table (the same sharded table as the
+non-GLOBAL path).  One jitted collective step replaces the reference's two
+RPC loops (sendHits + broadcastPeers):
+
+    all_to_all   hit deltas  ->  owner      (sendHits,  global.go:124-164)
+    apply        merged hits ->  auth shard (GetPeerRateLimits server side)
+    hits=0 read  broadcast rows              (broadcastPeers re-read :214-217)
+    all_gather   rows -> every cache shard  (UpdatePeerGlobals, :464-479)
+
+One deliberate deviation from the reference: the owner device also serves
+GLOBAL reads from its replicated cache rather than answering authoritatively
+(reference gubernator.go:272-283 answers authoritatively on the owner node).
+Routing GLOBAL traffic by owner would re-concentrate exactly the hot keys
+GLOBAL exists to spread; the eventual-consistency contract is unchanged.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from gubernator_tpu.core.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.ops.batch import pack_requests_grid
+from gubernator_tpu.ops.state import SlotTable, init_table
+from gubernator_tpu.ops.step import (
+    CachedRows,
+    DeviceBatchJ,
+    apply_batch_impl,
+    store_cached_rows_impl,
+)
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of_hash
+from gubernator_tpu.parallel.sharded import MeshBackend, _shard_map
+from gubernator_tpu.runtime.backend import (
+    resp_rounds_to_host,
+    unmarshal_responses,
+)
+
+
+class DeltaGrid(NamedTuple):
+    """Per-(source, owner) aggregated hit deltas: arrays [n_src, n_dst, D].
+
+    The device form of globalManager's `hits map[string]*RateLimitReq`
+    (global.go:87-95), already partitioned by owning shard.
+    """
+
+    key_hash: np.ndarray   # int64
+    hits: np.ndarray       # int64 (summed per key)
+    limit: np.ndarray      # int64
+    duration: np.ndarray   # int64
+    algo: np.ndarray       # int32
+    burst: np.ndarray      # int64
+    is_greg: np.ndarray    # bool
+    greg_expire: np.ndarray   # int64
+    greg_duration: np.ndarray  # int64
+
+
+def make_global_sync_step(mesh, ways: int):
+    """Build the jitted collective sync:
+    (auth, cache, delta, now) -> (auth', cache')."""
+
+    def _local(auth: SlotTable, cache: SlotTable, delta: DeltaGrid, now):
+        d = DeltaGrid(*[a[0] for a in delta])  # local [n_dst, D]
+        # sendHits: deltas travel to their owning shard over ICI.
+        recv = DeltaGrid(
+            *[
+                jax.lax.all_to_all(a, SHARD_AXIS, split_axis=0, concat_axis=0)
+                for a in d
+            ]
+        )  # [n_src, D] — this device's keys, from every source
+        key = recv.key_hash.reshape(-1)
+        b2 = key.shape[0]
+
+        # Merge duplicates across sources (same key hit on several devices):
+        # sort by key, segment-sum hits into the first occurrence.
+        order = jnp.argsort(key)
+        ks = key[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), ks[1:] != ks[:-1]]
+        )
+        seg = jnp.cumsum(first) - 1
+        hsum = jax.ops.segment_sum(
+            recv.hits.reshape(-1)[order], seg, num_segments=b2
+        )
+        act = first & (ks != 0)
+
+        def pick(a):
+            return a.reshape(-1)[order]
+
+        batch = DeviceBatchJ(
+            key_hash=ks,
+            hits=hsum[seg],
+            limit=pick(recv.limit),
+            duration=pick(recv.duration),
+            algo=pick(recv.algo),
+            burst=pick(recv.burst),
+            reset_remaining=jnp.zeros((b2,), dtype=bool),
+            is_greg=pick(recv.is_greg),
+            greg_expire=pick(recv.greg_expire),
+            greg_duration=pick(recv.greg_duration),
+            active=act,
+            use_cached=jnp.zeros((b2,), dtype=bool),
+        )
+        # Owner applies the aggregated hits (server side of sendHits).
+        auth, _ = apply_batch_impl(auth, batch, now, ways=ways)
+        # Broadcast status is a hits=0 re-read (broadcastPeers clears GLOBAL
+        # and zeroes Hits before getRateLimit, global.go:211-217).
+        auth, resp0 = apply_batch_impl(
+            auth, batch._replace(hits=jnp.zeros((b2,), dtype=jnp.int64)),
+            now, ways=ways,
+        )
+        rows = CachedRows(
+            key_hash=jnp.where(act, ks, 0),
+            algo=batch.algo,
+            limit=resp0.limit,
+            remaining=resp0.remaining,
+            status=resp0.status,
+            reset_time=resp0.reset_time,
+        )
+        # UpdatePeerGlobals to every peer: all_gather the authoritative rows
+        # and upsert them into this device's cache shard.
+        gathered = CachedRows(
+            *[
+                jax.lax.all_gather(a, SHARD_AXIS).reshape(-1)
+                for a in rows
+            ]
+        )
+        cache = store_cached_rows_impl(cache, gathered, now, ways=ways)
+        return auth, cache
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+@dataclass
+class _Pending:
+    """One key's queued hits since the last sync (global.go:87-95)."""
+
+    req: RateLimitReq
+    hits: int
+    src_dev: int
+
+
+_ARRIVAL_SHIFT = 44  # disjoint from owner-routing bits (32..) and bucket bits
+
+
+def arrival_dev(h64: int, n: int) -> int:
+    """Serving device for a GLOBAL key: deterministic hash spread, using
+    bits disjoint from both the owner shard and the bucket index.  Stateless
+    (no per-key host memory) — a key's serving device never changes, but all
+    broadcast rows exist on every device, so any assignment is correct."""
+    return int((np.uint64(h64) >> np.uint64(_ARRIVAL_SHIFT)) % np.uint64(n))
+
+
+class GlobalEngine:
+    """Host-side globalManager: replicated serving + periodic collective sync.
+
+    Owns the per-device cache tables (one sharded SlotTable) and the pending
+    hit-delta aggregation; applies authoritative updates to the MeshBackend's
+    sharded auth table inside the sync step.
+    """
+
+    def __init__(
+        self,
+        backend: MeshBackend,
+        delta_slots: int = 256,
+        batch_limit: int = 1000,
+    ) -> None:
+        self.b = backend
+        self.n = backend.cfg.num_shards
+        self.delta_slots = delta_slots
+        self.batch_limit = batch_limit
+        self.clock = backend.clock
+        self.cache_table: SlotTable = jax.device_put(
+            init_table(backend.cfg.num_slots), backend._tsharding
+        )
+        self._ingest = backend._step  # same sharded step, run on cache table
+        self._sync_step = make_global_sync_step(backend.mesh, backend.cfg.ways)
+        self._lock = threading.Lock()  # cache_table + pending + metrics
+        self.pending: Dict[str, _Pending] = {}
+        # Metrics (global.go:48-57 async/broadcast durations + counts).
+        self.syncs = 0
+        self.sync_keys = 0
+        self.dropped = 0
+
+    # -- serving path ----------------------------------------------------
+    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        """Serve GLOBAL checks from the replicated cache tables
+        (getGlobalRateLimit, gubernator.go:420-460) and queue the hits.
+
+        Duplicate keys within one call are pre-aggregated (hits summed, the
+        reference's own global.go:87-95 aggregation applied at ingest), so a
+        hot key costs one lane per batch; the duplicates share one response.
+        This deviates from per-hit interim decrements in the pre-broadcast
+        window but keeps the same eventual-consistency contract.
+        """
+        from gubernator_tpu.core.hashing import key_hash64
+
+        agg_idx: Dict[str, int] = {}
+        agg_reqs: List[RateLimitReq] = []
+        idx_map: List[int] = []
+        for r in reqs:
+            if r.name and r.unique_key:
+                key = r.hash_key()
+                j = agg_idx.get(key)
+                if j is not None:
+                    a = agg_reqs[j]
+                    agg_reqs[j] = RateLimitReq(
+                        **{**a.__dict__, "hits": a.hits + r.hits}
+                    )
+                    idx_map.append(j)
+                    continue
+                agg_idx[key] = len(agg_reqs)
+            idx_map.append(len(agg_reqs))
+            agg_reqs.append(r)
+
+        packed = pack_requests_grid(
+            agg_reqs, self.b.cfg.batch_size, self.n,
+            lambda key: arrival_dev(key_hash64(key), self.n),
+            self.clock,
+        )
+        for db in packed.rounds:
+            np.copyto(db.use_cached, db.active)
+        now = np.int64(self.clock.millisecond_now())
+
+        round_resps = []
+        with self._lock:
+            for db in packed.rounds:
+                batch = DeviceBatchJ(
+                    *[jax.device_put(a, self.b._bsharding) for a in db]
+                )
+                self.cache_table, resp = self._ingest(
+                    self.cache_table, batch, now
+                )
+                round_resps.append(resp)
+            # Queue hits AFTER preparing the response (the deferred QueueHit,
+            # gubernator.go:429-432).
+            for j, r in enumerate(agg_reqs):
+                if j in packed.errors:
+                    continue
+                key = r.hash_key()
+                p = self.pending.get(key)
+                if p is None:
+                    self.pending[key] = _Pending(
+                        req=r, hits=r.hits,
+                        src_dev=arrival_dev(key_hash64(key), self.n),
+                    )
+                else:
+                    p.hits += r.hits
+                    p.req = r
+            want_sync = len(self.pending) >= self.batch_limit
+
+        agg_out, tally = unmarshal_responses(
+            len(agg_reqs), packed.errors, packed.positions,
+            resp_rounds_to_host(round_resps),
+        )
+        self.b._add_tally(tally)
+        if want_sync:
+            self.sync()
+        return [agg_out[j] for j in idx_map]
+
+    # -- sync path -------------------------------------------------------
+    def sync(self) -> int:
+        """Run the collective hits->owner->broadcast step; returns #keys."""
+        with self._lock:
+            pending, self.pending = self.pending, {}
+        if not pending:
+            return 0
+        now_dt = self.clock.now()
+        chunks = self._build_chunks(pending, now_dt)
+        now = np.int64(self.clock.millisecond_now())
+        for grid in chunks:
+            sharded = DeltaGrid(
+                *[jax.device_put(a, self.b._bsharding) for a in grid]
+            )
+            # Lock order: auth (backend) before cache (self).
+            with self.b._lock, self._lock:
+                self.b.table, self.cache_table = self._sync_step(
+                    self.b.table, self.cache_table, sharded, now
+                )
+        with self._lock:
+            self.syncs += 1
+            self.sync_keys += len(pending)
+        return len(pending)
+
+    def _build_chunks(self, pending: Dict[str, _Pending], now_dt):
+        """Pack pending deltas into [n, n, D] grids (chunked on overflow)."""
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.core.interval import (
+            GregorianError,
+            gregorian_duration,
+            gregorian_expiration,
+        )
+        from gubernator_tpu.core.types import Behavior, has_behavior
+
+        n, D = self.n, self.delta_slots
+        chunks: List[DeltaGrid] = []
+        fill: List[np.ndarray] = []  # [n, n] lane counters per chunk
+
+        def new_chunk() -> DeltaGrid:
+            z64 = lambda: np.zeros((n, n, D), dtype=np.int64)
+            g = DeltaGrid(
+                key_hash=z64(), hits=z64(), limit=z64(), duration=z64(),
+                algo=np.zeros((n, n, D), dtype=np.int32), burst=z64(),
+                is_greg=np.zeros((n, n, D), dtype=bool),
+                greg_expire=z64(), greg_duration=z64(),
+            )
+            chunks.append(g)
+            fill.append(np.zeros((n, n), dtype=np.int64))
+            return g
+
+        def fill_lane(ci: int, lane: int, h64, p: _Pending, is_greg, ge, gd):
+            g, r = chunks[ci], p.req
+            src, dst = p.src_dev, int(shard_of_hash(h64, n))
+            g.key_hash[src, dst, lane] = np.int64(np.uint64(h64).view(np.int64))
+            g.hits[src, dst, lane] = p.hits
+            g.limit[src, dst, lane] = r.limit
+            g.duration[src, dst, lane] = r.duration
+            g.algo[src, dst, lane] = int(r.algorithm)
+            g.burst[src, dst, lane] = r.burst if r.burst != 0 else r.limit
+            g.is_greg[src, dst, lane] = is_greg
+            g.greg_expire[src, dst, lane] = ge
+            g.greg_duration[src, dst, lane] = gd
+            fill[ci][src, dst] = lane + 1
+
+        for key, p in pending.items():
+            r = p.req
+            h64 = key_hash64(key)
+            src, dst = p.src_dev, int(shard_of_hash(h64, n))
+            is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
+            ge = gd = 0
+            if is_greg:
+                try:
+                    ge = gregorian_expiration(now_dt, r.duration)
+                    gd = gregorian_duration(now_dt, r.duration)
+                except GregorianError:
+                    with self._lock:
+                        self.dropped += 1
+                    continue
+            while True:
+                for ci in range(len(chunks)):
+                    lane = int(fill[ci][src, dst])
+                    if lane < D:
+                        fill_lane(ci, lane, h64, p, is_greg, ge, gd)
+                        break
+                else:
+                    new_chunk()
+                    continue
+                break
+        if not chunks:
+            new_chunk()
+        return chunks
+
+    # -- point reads (tests / HealthCheck) -------------------------------
+    def get_cached(self, key: str):
+        """Read this key's row from its serving device's cache table."""
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.runtime.backend import probe_bucket
+
+        dev = arrival_dev(key_hash64(key), self.n)
+        lo = self.b.bucket_offset(key, dev)
+        now = self.clock.millisecond_now()
+        with self._lock:
+            return probe_bucket(
+                self.cache_table, lo, self.b.cfg.ways, key, now
+            )

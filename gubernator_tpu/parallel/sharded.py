@@ -38,7 +38,7 @@ from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
 from gubernator_tpu.ops.step import DeviceBatchJ, Resp, apply_batch_impl
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_of_hash
 from gubernator_tpu.runtime.backend import (
-    _row_to_item,
+    probe_bucket,
     resp_rounds_to_host,
     unmarshal_responses,
 )
@@ -143,24 +143,18 @@ class MeshBackend:
         return out
 
     # -- point reads / persistence ---------------------------------------
-    def get_cache_item(self, key: str) -> Optional[CacheItem]:
-        h64 = key_hash64(key)
-        h = int(np.uint64(h64).view(np.int64))
-        shard = int(shard_of_hash(h64, self.cfg.num_shards))
+    def bucket_offset(self, key: str, shard: int) -> int:
+        """Global row index of `key`'s bucket within `shard`'s table block."""
         nb_local = self.local_slots // self.cfg.ways
-        bucket = h64 & (nb_local - 1)
-        lo = shard * self.local_slots + bucket * self.cfg.ways
-        hi = lo + self.cfg.ways
-        with self._lock:
-            rows = {
-                f: np.asarray(getattr(self.table, f)[lo:hi])
-                for f in self.table._fields
-            }
+        bucket = key_hash64(key) & (nb_local - 1)
+        return shard * self.local_slots + bucket * self.cfg.ways
+
+    def get_cache_item(self, key: str) -> Optional[CacheItem]:
+        shard = int(shard_of_hash(key_hash64(key), self.cfg.num_shards))
+        lo = self.bucket_offset(key, shard)
         now = self.clock.millisecond_now()
-        for w in range(self.cfg.ways):
-            if rows["key"][w] == h and rows["expire_at"][w] > now:
-                return _row_to_item(rows, w, key)
-        return None
+        with self._lock:
+            return probe_bucket(self.table, lo, self.cfg.ways, key, now)
 
     def snapshot(self) -> Dict[str, np.ndarray]:
         with self._lock:

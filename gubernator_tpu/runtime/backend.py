@@ -92,22 +92,14 @@ class DeviceBackend:
 
     # -- cache item access (GLOBAL path + persistence SPI) ---------------
     def get_cache_item(self, key: str) -> Optional[CacheItem]:
-        """Host-side point read of one key (WorkerPool.GetCacheItem,
-        workers.go:614-646).  Used by the GLOBAL read path and tests; reads
-        only the key's bucket (`ways` slots), not the whole table."""
-        h = int(np.uint64(key_hash64(key)).view(np.int64))
+        """Point read of one key; reads only the key's bucket (`ways` slots),
+        not the whole table."""
         ways = self.cfg.ways
         nb = self.cfg.num_slots // ways
         bucket = key_hash64(key) & (nb - 1)
-        lo, hi = bucket * ways, (bucket + 1) * ways
-        with self._lock:
-            rows = {f: np.asarray(getattr(self.table, f)[lo:hi])
-                    for f in self.table._fields}
         now = self.clock.millisecond_now()
-        for w in range(ways):
-            if rows["key"][w] == h and rows["expire_at"][w] > now:
-                return _row_to_item(rows, w, key)
-        return None
+        with self._lock:
+            return probe_bucket(self.table, bucket * ways, ways, key, now)
 
     def snapshot(self) -> Dict[str, np.ndarray]:
         """Device->host DMA of the whole table (Loader save path,
@@ -177,6 +169,24 @@ def unmarshal_responses(
         if not r["persisted"][idx]:
             notp += 1
     return out, Tally(checks, over, notp)
+
+
+def probe_bucket(
+    table: SlotTable, lo: int, ways: int, key: str, now: int
+) -> Optional[CacheItem]:
+    """Host-side point read of one bucket: DMA `ways` rows starting at `lo`
+    and return the live item for `key`, if any (the WorkerPool.GetCacheItem
+    analog, workers.go:614-646; expired rows read as misses like
+    lrucache.go:115-127)."""
+    rows = {
+        f: np.asarray(getattr(table, f)[lo:lo + ways])
+        for f in table._fields
+    }
+    h = int(np.uint64(key_hash64(key)).view(np.int64))
+    for w in range(ways):
+        if rows["key"][w] == h and rows["expire_at"][w] > now:
+            return _row_to_item(rows, w, key)
+    return None
 
 
 def _to_device(db: DeviceBatch) -> DeviceBatchJ:

@@ -1,0 +1,164 @@
+"""GLOBAL behavior: replicated serving + collective sync on the mesh.
+
+Mirrors the reference functional suite's TestGlobalRateLimits
+(functional_test.go:800-867): non-owners answer locally, hits propagate to
+the owner asynchronously, and the owner's authoritative status broadcasts
+back — here via all_to_all/all_gather on a virtual 8-device mesh instead of
+peer RPC.
+"""
+import numpy as np
+
+from gubernator_tpu.core.config import DeviceConfig
+from gubernator_tpu.core.hashing import key_hash64
+from gubernator_tpu.core.types import Behavior, RateLimitReq, Status
+from gubernator_tpu.parallel.global_sync import DeltaGrid, GlobalEngine
+from gubernator_tpu.parallel.mesh import shard_of_hash
+from gubernator_tpu.parallel.sharded import MeshBackend
+
+
+def _engine(frozen_clock, **kw):
+    cfg = DeviceConfig(
+        num_slots=8 * 1024, ways=8, batch_size=64, num_shards=8
+    )
+    b = MeshBackend(cfg, clock=frozen_clock)
+    return b, GlobalEngine(b, delta_slots=16, **kw)
+
+
+def _greq(key, hits=1, limit=10):
+    return RateLimitReq(
+        name="g", unique_key=key, hits=hits, limit=limit,
+        duration=60_000, behavior=Behavior.GLOBAL,
+    )
+
+
+def test_local_processing_before_broadcast(frozen_clock):
+    """Cache miss -> 'process the rate limit like we own it'
+    (gubernator.go:449-458): local interim bucket, decremented per hit."""
+    _, eng = _engine(frozen_clock)
+    assert eng.check([_greq("a")])[0].remaining == 9
+    assert eng.check([_greq("a")])[0].remaining == 8
+    assert len(eng.pending) == 1
+    assert eng.pending["g_a"].hits == 2
+
+
+def test_sync_applies_hits_to_owner_and_broadcasts(frozen_clock):
+    back, eng = _engine(frozen_clock)
+    eng.check([_greq("a"), _greq("a")])
+    assert eng.sync() == 1
+
+    # Owner's authoritative state in the sharded auth table.
+    item = back.get_cache_item("g_a")
+    assert item is not None and item.remaining == 8
+
+    # Broadcast row landed in the serving cache (UpdatePeerGlobals analog).
+    cached = eng.get_cached("g_a")
+    assert cached is not None
+    assert cached.remaining == 8
+
+    # Served reads now come from the broadcast row verbatim (stale-but-fast:
+    # remaining does NOT decrement locally, gubernator.go:434-447)...
+    assert eng.check([_greq("a")])[0].remaining == 8
+    assert eng.check([_greq("a")])[0].remaining == 8
+    # ...while the hits queue; the next sync reconciles them on the owner.
+    eng.sync()
+    assert back.get_cache_item("g_a").remaining == 6
+    assert eng.check([_greq("a", hits=0)])[0].remaining == 6
+
+
+def test_over_limit_propagates_eventually(frozen_clock):
+    back, eng = _engine(frozen_clock)
+    r = eng.check([_greq("b", hits=5, limit=5)])[0]
+    assert r.status == Status.UNDER_LIMIT and r.remaining == 0
+    eng.sync()
+    # Stale answer: broadcast row still UNDER (owner status only flips when
+    # hits arrive at remaining==0 — algorithms.go:167-173).
+    r = eng.check([_greq("b", hits=1, limit=5)])[0]
+    assert r.status == Status.UNDER_LIMIT and r.remaining == 0
+    eng.sync()
+    r = eng.check([_greq("b", hits=1, limit=5)])[0]
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_spread_keys_match_oracle_totals(frozen_clock):
+    """Aggregated application equals sequential application while under
+    limit: many keys spread round-robin over devices."""
+    back, eng = _engine(frozen_clock)
+    keys = [f"k{i}" for i in range(24)]
+    for rep in range(3):
+        resps = eng.check([_greq(k, hits=1, limit=100) for k in keys])
+        assert all(r.error == "" for r in resps)
+    from gubernator_tpu.parallel.global_sync import arrival_dev
+
+    devs = {arrival_dev(key_hash64(f"g_{k}"), 8) for k in keys}
+    assert len(devs) >= 4  # keys hash-spread over serving devices
+    eng.sync()
+    for k in keys:
+        item = back.get_cache_item(f"g_{k}")
+        assert item is not None and item.remaining == 97, k
+
+
+def test_merge_across_sources(frozen_clock):
+    """Same key hit on two source devices merges (segment-sum) before the
+    owner applies it — the all_to_all + dedup path."""
+    back, eng = _engine(frozen_clock)
+    n, D = 8, 16
+    key = "g_merge"
+    h64 = key_hash64(key)
+    dst = int(shard_of_hash(h64, n))
+    h = np.int64(np.uint64(h64).view(np.int64))
+
+    grid = DeltaGrid(
+        key_hash=np.zeros((n, n, D), dtype=np.int64),
+        hits=np.zeros((n, n, D), dtype=np.int64),
+        limit=np.zeros((n, n, D), dtype=np.int64),
+        duration=np.zeros((n, n, D), dtype=np.int64),
+        algo=np.zeros((n, n, D), dtype=np.int32),
+        burst=np.zeros((n, n, D), dtype=np.int64),
+        is_greg=np.zeros((n, n, D), dtype=bool),
+        greg_expire=np.zeros((n, n, D), dtype=np.int64),
+        greg_duration=np.zeros((n, n, D), dtype=np.int64),
+    )
+    for src, hits in ((0, 2), (3, 5)):
+        grid.key_hash[src, dst, 0] = h
+        grid.hits[src, dst, 0] = hits
+        grid.limit[src, dst, 0] = 100
+        grid.duration[src, dst, 0] = 60_000
+        grid.burst[src, dst, 0] = 100
+
+    import jax
+
+    now = np.int64(frozen_clock.millisecond_now())
+    sharded = DeltaGrid(
+        *[jax.device_put(a, eng.b._bsharding) for a in grid]
+    )
+    back.table, eng.cache_table = eng._sync_step(
+        back.table, eng.cache_table, sharded, now
+    )
+    item = back.get_cache_item(key)
+    assert item is not None
+    assert item.remaining == 93  # 100 - (2 + 5)
+    # Broadcast landed on every device's cache, including the serving one.
+    cached = eng.get_cached(key)
+    assert cached is not None and cached.remaining == 93
+
+
+def test_hot_key_aggregates_to_one_lane(frozen_clock):
+    """Duplicates of one GLOBAL key in a call are pre-aggregated: one lane,
+    one shared response, one pending entry with summed hits."""
+    back, eng = _engine(frozen_clock)
+    resps = eng.check([_greq("hot", hits=1, limit=100)] * 50)
+    assert len(resps) == 50
+    assert all(r.remaining == 50 for r in resps)  # one 50-hit application
+    assert eng.pending["g_hot"].hits == 50
+    eng.sync()
+    assert back.get_cache_item("g_hot").remaining == 50
+
+
+def test_batch_limit_triggers_sync(frozen_clock):
+    back, eng = _engine(frozen_clock, batch_limit=4)
+    for i in range(4):
+        eng.check([_greq(f"t{i}", limit=50)])
+    # 4 distinct pending keys reached the batch limit -> auto sync.
+    assert eng.syncs == 1
+    assert len(eng.pending) == 0
+    assert back.get_cache_item("g_t0").remaining == 49
