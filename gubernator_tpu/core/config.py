@@ -88,14 +88,20 @@ class DaemonConfig:
     data_center: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
-    peer_discovery_type: str = "none"  # none|static|dns
+    peer_discovery_type: str = "none"  # none|static|dns|gossip|k8s|etcd
     static_peers: List[str] = field(default_factory=list)
     dns_fqdn: str = ""
     dns_poll_interval_s: float = 10.0
+    gossip_bind_address: str = ""  # host:port UDP; default grpc_port+1000
+    gossip_seeds: List[str] = field(default_factory=list)
+    etcd_endpoints: str = "localhost:2379"
     log_level: str = "info"
     # TLS (reference tls.go / config.go:338-368)
     tls: Optional["TLSConfig"] = None
     metric_flags: int = 0
+    # Persistence SPI (runtime.store.Loader / Store)
+    loader: Optional[object] = None
+    store: Optional[object] = None
 
 
 @dataclass
@@ -195,6 +201,13 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         static_peers=static_peers,
         dns_fqdn=_env("GUBER_DNS_FQDN", ""),
         dns_poll_interval_s=_env_float_s("GUBER_DNS_POLL_INTERVAL", 10.0),
+        gossip_bind_address=_env("GUBER_GOSSIP_ADDRESS", ""),
+        gossip_seeds=[
+            s.strip()
+            for s in _env("GUBER_GOSSIP_SEEDS").split(",")
+            if s.strip()
+        ],
+        etcd_endpoints=_env("GUBER_ETCD_ENDPOINTS", "localhost:2379"),
         log_level=_env("GUBER_LOG_LEVEL", "info"),
         tls=tls,
     )
@@ -203,10 +216,12 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
 def fast_test_behaviors() -> BehaviorConfig:
     """Short windows for tests (reference cluster/cluster.go:119-125)."""
     return BehaviorConfig(
-        batch_timeout_s=0.1,
+        batch_timeout_s=2.0,
         batch_wait_s=0.01,
         batch_limit=DEFAULT_BATCH_LIMIT,
-        global_timeout_s=0.1,
+        global_timeout_s=2.0,
         global_sync_wait_s=0.05,
         global_batch_limit=DEFAULT_BATCH_LIMIT,
+        multi_region_timeout_s=2.0,
+        multi_region_sync_wait_s=0.05,
     )

@@ -1,0 +1,398 @@
+"""Daemon assembly: gRPC + HTTP servers, discovery, metrics, lifecycle.
+
+The analog of the reference daemon (daemon.go:45-442): builds the metrics
+registry, the gRPC server hosting both V1 and PeersV1, the JSON/REST
+gateway with under_score marshaling (daemon.go:231-249), the `/metrics`
+endpoint, the discovery pool, and readiness gating — all on one asyncio
+loop, so many daemons can share a process (the in-process cluster fixture
+depends on this, cluster/cluster.go:111-146).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional, Sequence
+
+import grpc
+import grpc.aio
+from aiohttp import web
+from google.protobuf import json_format
+
+from gubernator_tpu.core.config import Config, DaemonConfig
+from gubernator_tpu.core.types import PeerInfo
+from gubernator_tpu.net import grpc_api
+from gubernator_tpu.net.netutil import resolve_host_ip
+from gubernator_tpu.net.tls import TLSBundle, setup_tls
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2
+from gubernator_tpu.runtime.metrics import Metrics
+from gubernator_tpu.runtime.service import ApiError, Service
+
+log = logging.getLogger("gubernator_tpu.daemon")
+
+_GRPC_CODES = {
+    "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    "INTERNAL": grpc.StatusCode.INTERNAL,
+}
+
+
+class _V1Servicer:
+    """pb2 <-> Service adapter for the client-facing V1 service."""
+
+    def __init__(self, daemon: "Daemon") -> None:
+        self.d = daemon
+
+    async def GetRateLimits(self, request, context):
+        m = self.d.metrics
+        start = time.monotonic()
+        failed = "false"
+        try:
+            reqs = grpc_api.reqs_from_pb(request.requests)
+            try:
+                resps = await self.d.service.get_rate_limits(reqs)
+            except ApiError as e:
+                failed = "true"
+                await context.abort(
+                    _GRPC_CODES.get(e.code, grpc.StatusCode.INTERNAL), str(e)
+                )
+            return pb.GetRateLimitsResp(
+                responses=grpc_api.resps_to_pb(resps)
+            )
+        finally:
+            m.grpc_request_counts.labels(
+                method="/pb.gubernator.V1/GetRateLimits", failed=failed
+            ).inc()
+            m.grpc_request_duration.labels(
+                method="/pb.gubernator.V1/GetRateLimits"
+            ).observe(time.monotonic() - start)
+
+    async def HealthCheck(self, request, context):
+        h = await self.d.service.health_check()
+        return grpc_api.health_to_pb(h)
+
+
+class _PeersServicer:
+    """pb2 <-> Service adapter for the peer-to-peer PeersV1 service."""
+
+    def __init__(self, daemon: "Daemon") -> None:
+        self.d = daemon
+
+    async def GetPeerRateLimits(self, request, context):
+        try:
+            reqs = grpc_api.reqs_from_pb(request.requests)
+            resps = await self.d.service.get_peer_rate_limits(reqs)
+        except ApiError as e:
+            await context.abort(
+                _GRPC_CODES.get(e.code, grpc.StatusCode.INTERNAL), str(e)
+            )
+        return peers_pb2.GetPeerRateLimitsResp(
+            rate_limits=grpc_api.resps_to_pb(resps)
+        )
+
+    async def UpdatePeerGlobals(self, request, context):
+        globals_ = [grpc_api.global_from_pb(g) for g in request.globals]
+        await self.d.service.update_peer_globals(globals_)
+        return peers_pb2.UpdatePeerGlobalsResp()
+
+
+class Daemon:
+    """One gubernator-tpu node."""
+
+    def __init__(
+        self,
+        conf: Optional[DaemonConfig] = None,
+        clock=None,
+    ) -> None:
+        self.conf = conf or DaemonConfig()
+        self.clock = clock
+        self.metrics = Metrics()
+        self.tls: Optional[TLSBundle] = setup_tls(self.conf.tls)
+        self.service: Optional[Service] = None
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self._pool = None
+        self._peers: List[PeerInfo] = []
+        self.grpc_address = self.conf.grpc_listen_address
+        self.http_address = self.conf.http_listen_address
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self) -> None:
+        cfg = Config(
+            behaviors=self.conf.behaviors,
+            device=self.conf.device,
+            cache_size=self.conf.cache_size,
+            data_center=self.conf.data_center,
+            loader=getattr(self.conf, "loader", None),
+            store=getattr(self.conf, "store", None),
+        )
+        peer_creds = (
+            self.tls.client_credentials() if self.tls is not None else None
+        )
+        self.service = Service(
+            cfg,
+            clock=self.clock,
+            peer_credentials=peer_creds,
+            metrics=self.metrics,
+        )
+        await self.service.start()
+
+        # gRPC server (daemon.go:101-126): both services on one listener.
+        server = grpc.aio.server(
+            options=[
+                ("grpc.max_receive_message_length", 1024 * 1024),  # 1MB cap
+            ]
+        )
+        server.add_generic_rpc_handlers((
+            grpc_api.v1_generic_handler(_V1Servicer(self)),
+            grpc_api.peers_generic_handler(_PeersServicer(self)),
+        ))
+        if self.tls is not None:
+            port = server.add_secure_port(
+                self.conf.grpc_listen_address,
+                self.tls.server_credentials(),
+            )
+        else:
+            port = server.add_insecure_port(self.conf.grpc_listen_address)
+        if port == 0:
+            raise RuntimeError(
+                f"failed to bind {self.conf.grpc_listen_address}"
+            )
+        # Rewrite :0 ephemeral binds to the actual port for advertisement.
+        host = self.conf.grpc_listen_address.rpartition(":")[0]
+        self.grpc_address = f"{host}:{port}"
+        await server.start()
+        self._grpc_server = server
+
+        await self._start_http()
+        await self._start_discovery()
+        log.info(
+            "gubernator-tpu daemon up: grpc=%s http=%s",
+            self.grpc_address, self.http_address,
+        )
+
+    async def close(self) -> None:
+        if self._pool is not None:
+            await self._pool.close()
+            self._pool = None
+        if self.service is not None:
+            await self.service.close()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=1.0)
+            self._grpc_server = None
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+            self._http_runner = None
+
+    # -- HTTP gateway (daemon.go:231-270) --------------------------------
+    async def _start_http(self) -> None:
+        app = web.Application()
+        app.router.add_post("/v1/GetRateLimits", self._http_get_rate_limits)
+        app.router.add_get("/v1/HealthCheck", self._http_health)
+        app.router.add_get("/metrics", self._http_metrics)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        host, _, port = self.conf.http_listen_address.rpartition(":")
+        ssl_ctx = (
+            self.tls.server_ssl_context() if self.tls is not None else None
+        )
+        site = web.TCPSite(runner, host or "0.0.0.0", int(port),
+                           ssl_context=ssl_ctx)
+        await site.start()
+        actual_port = site._server.sockets[0].getsockname()[1]
+        self.http_address = f"{host}:{actual_port}"
+        self._http_runner = runner
+
+    async def _http_get_rate_limits(self, request: web.Request):
+        """REST gateway contract: JSON with under_score field names
+        (daemon.go:241-243 marshaler options)."""
+        try:
+            body = await request.text()
+            msg = json_format.Parse(body, pb.GetRateLimitsReq())
+        except json_format.ParseError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        try:
+            resps = await self.service.get_rate_limits(
+                grpc_api.reqs_from_pb(msg.requests)
+            )
+        except ApiError as e:
+            return web.json_response(
+                {"error": str(e), "code": e.code}, status=400
+            )
+        out = pb.GetRateLimitsResp(responses=grpc_api.resps_to_pb(resps))
+        return web.Response(
+            text=json_format.MessageToJson(
+                out,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            ),
+            content_type="application/json",
+        )
+
+    async def _http_health(self, request: web.Request):
+        h = await self.service.health_check()
+        return web.Response(
+            text=json_format.MessageToJson(
+                grpc_api.health_to_pb(h),
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            ),
+            content_type="application/json",
+        )
+
+    async def _http_metrics(self, request: web.Request):
+        # Refresh device gauges at scrape time.
+        if self.service is not None:
+            self.metrics.device_occupancy.set(
+                self.service.backend.occupancy()
+            )
+            self.metrics.cache_size.set(self.service.backend.occupancy())
+        return web.Response(
+            body=self.metrics.render(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    # -- peers / discovery ----------------------------------------------
+    def advertise_address(self) -> str:
+        return self.conf.advertise_address or resolve_host_ip(
+            self.grpc_address
+        )
+
+    async def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Mark ourselves in the peer list and hand it to the service
+        (daemon.go:375-385 sets IsOwner on the local instance)."""
+        me = self.advertise_address()
+        marked = [
+            PeerInfo(
+                grpc_address=p.grpc_address,
+                http_address=p.http_address,
+                data_center=p.data_center,
+                is_owner=(p.grpc_address == me),
+            )
+            for p in peers
+        ]
+        self._peers = marked
+        await self.service.set_peers(marked)
+
+    def peers(self) -> List[PeerInfo]:
+        return list(self._peers)
+
+    async def _start_discovery(self) -> None:
+        kind = self.conf.peer_discovery_type
+        if kind in ("none", ""):
+            return
+        loop = asyncio.get_running_loop()
+
+        def on_update(peers: Sequence[PeerInfo]) -> None:
+            # Pools usually run on this loop, but some sources (etcd watch
+            # callbacks) fire from background threads — route accordingly.
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is loop:
+                asyncio.ensure_future(self.set_peers(peers))
+            else:
+                asyncio.run_coroutine_threadsafe(self.set_peers(peers), loop)
+
+        if kind == "static":
+            from gubernator_tpu.discovery.static import StaticPool
+
+            peers = [
+                PeerInfo(grpc_address=a) for a in self.conf.static_peers
+            ]
+            me = self.advertise_address()
+            if all(p.grpc_address != me for p in peers):
+                peers.append(PeerInfo(grpc_address=me))
+            self._pool = StaticPool(peers, on_update)
+        elif kind == "dns":
+            from gubernator_tpu.discovery.dns import DnsPool
+
+            grpc_port = int(self.grpc_address.rpartition(":")[2])
+            http_port = int(self.http_address.rpartition(":")[2])
+            self._pool = DnsPool(
+                self.conf.dns_fqdn,
+                on_update,
+                grpc_port=grpc_port,
+                http_port=http_port,
+                poll_interval_s=self.conf.dns_poll_interval_s,
+                data_center=self.conf.data_center,
+                own_address=self.advertise_address(),
+            )
+        elif kind == "gossip":
+            from gubernator_tpu.discovery.gossip import GossipPool
+
+            gossip_port = int(self.grpc_address.rpartition(":")[2]) + 1000
+            bind = self.conf.gossip_bind_address or f"0.0.0.0:{gossip_port}"
+            # Gossip identity rides the daemon's advertise host.
+            adv_host = self.advertise_address().rpartition(":")[0]
+            bind_port = bind.rpartition(":")[2]
+            self._pool = GossipPool(
+                bind,
+                PeerInfo(
+                    grpc_address=self.advertise_address(),
+                    http_address=self.http_address,
+                    data_center=self.conf.data_center,
+                ),
+                on_update,
+                seeds=self.conf.gossip_seeds,
+                advertise_address=f"{adv_host}:{bind_port}",
+            )
+        elif kind == "k8s":
+            from gubernator_tpu.discovery.k8s import K8sPool
+
+            self._pool = K8sPool(on_update)
+        elif kind == "etcd":
+            from gubernator_tpu.discovery.etcd import EtcdPool
+
+            self._pool = EtcdPool(
+                on_update,
+                PeerInfo(
+                    grpc_address=self.advertise_address(),
+                    http_address=self.http_address,
+                    data_center=self.conf.data_center,
+                ),
+                endpoints=getattr(
+                    self.conf, "etcd_endpoints", "localhost:2379"
+                ),
+            )
+        else:
+            raise ValueError(f"unknown peer_discovery_type '{kind}'")
+        await self._pool.start()
+
+
+async def spawn_daemon(conf: DaemonConfig, clock=None) -> Daemon:
+    """Create + start a daemon (SpawnDaemon, daemon.go:66-79)."""
+    d = Daemon(conf, clock=clock)
+    await d.start()
+    return d
+
+
+async def wait_for_connect(
+    addresses: Sequence[str],
+    timeout_s: float = 10.0,
+    credentials=None,
+) -> None:
+    """Block until every address accepts a gRPC connection
+    (daemon.go:403-442)."""
+    deadline = time.monotonic() + timeout_s
+    for addr in addresses:
+        while True:
+            if credentials is not None:
+                ch = grpc.aio.secure_channel(addr, credentials)
+            else:
+                ch = grpc.aio.insecure_channel(addr)
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"timed out connecting to {addr}")
+                await asyncio.wait_for(
+                    ch.channel_ready(), timeout=remaining
+                )
+                break
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"timed out connecting to {addr}")
+            finally:
+                await ch.close()

@@ -1,0 +1,6 @@
+"""Host networking tier: wire codecs, peer picking, peer client/batcher.
+
+This is the DCN side of the framework — client API and cross-host peer
+traffic ride gRPC here, while intra-pod replication rides XLA collectives
+(gubernator_tpu.parallel.global_sync).
+"""

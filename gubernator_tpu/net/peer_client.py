@@ -1,0 +1,259 @@
+"""Peer client: one gRPC channel per peer with an async request batcher.
+
+Forwarded checks amortize RPC cost the same way the reference does
+(peer_client.go:39-573): requests enqueue onto a bounded queue; a background
+task flushes when `batch_limit` (default 1000) items are pending or
+`batch_wait` (default 500µs) elapses after the first enqueue, issuing ONE
+GetPeerRateLimits RPC whose responses are demultiplexed back to the waiting
+callers in order (peers.proto order-preservation contract).  NO_BATCHING
+requests bypass the queue with a direct single-item RPC.
+
+Differences from the reference are deliberate asyncio re-expressions:
+goroutine+channel batcher -> asyncio task + futures; WaitGroup drain on
+shutdown -> in-flight counter + event.  The rolling per-peer error window
+feeding HealthCheck (peer_client.go:271-300) is a deque pruned by timestamp.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import Deque, List, Optional, Tuple
+
+import grpc
+import grpc.aio
+
+from gubernator_tpu.core.config import BehaviorConfig
+from gubernator_tpu.core.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.net import grpc_api
+from gubernator_tpu.proto import peers_pb2
+
+ERROR_WINDOW_S = 300.0  # keep peer errors 5 min (peer_client.go:282)
+
+
+class PeerNotReadyError(RuntimeError):
+    """Routing-layer retry signal: peer is shutting down or unreachable
+    (the reference's PeerErr/IsNotReady, peer_client.go:549-573)."""
+
+
+class PeerClient:
+    """Async client for one peer, with batching."""
+
+    def __init__(
+        self,
+        info: PeerInfo,
+        behavior: Optional[BehaviorConfig] = None,
+        channel_credentials: Optional[grpc.ChannelCredentials] = None,
+    ) -> None:
+        self.peer_info = info
+        self.behavior = behavior or BehaviorConfig()
+        self._creds = channel_credentials
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._stub: Optional[grpc_api.PeersV1Stub] = None
+        self._connect_lock = asyncio.Lock()
+        # Batch queue: (request, future) pairs.
+        self._queue: asyncio.Queue[Tuple[RateLimitReq, asyncio.Future]] = (
+            asyncio.Queue(maxsize=1000)
+        )
+        self._batcher_task: Optional[asyncio.Task] = None
+        self._shutdown = False
+        self._inflight = 0
+        self._drained = asyncio.Event()
+        self._drained.set()
+        self._errors: Deque[Tuple[float, str]] = collections.deque(maxlen=100)
+
+    def info(self) -> PeerInfo:
+        return self.peer_info
+
+    # -- connection ------------------------------------------------------
+    async def _connect(self) -> grpc_api.PeersV1Stub:
+        """Lazy dial; also spawns the batcher on first use
+        (peer_client.go:96-159)."""
+        if self._stub is not None:
+            return self._stub
+        async with self._connect_lock:
+            if self._stub is not None:
+                return self._stub
+            if self._shutdown:
+                raise PeerNotReadyError(
+                    f"peer {self.peer_info.grpc_address} is shut down"
+                )
+            if self._creds is not None:
+                self._channel = grpc.aio.secure_channel(
+                    self.peer_info.grpc_address, self._creds
+                )
+            else:
+                self._channel = grpc.aio.insecure_channel(
+                    self.peer_info.grpc_address
+                )
+            self._stub = grpc_api.PeersV1Stub(self._channel)
+            self._batcher_task = asyncio.ensure_future(self._run_batcher())
+            return self._stub
+
+    # -- public API ------------------------------------------------------
+    async def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+        """Forward one check to this peer, batched unless the request (or a
+        sub-window batch-wait of 0) opts out (peer_client.go:168-192)."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        self._track_inflight(+1)
+        try:
+            if has_behavior(req.behavior, Behavior.NO_BATCHING):
+                resps = await self._call_get_peer_rate_limits([req])
+                return resps[0]
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            try:
+                self._queue.put_nowait((req, fut))
+            except asyncio.QueueFull as e:
+                raise PeerNotReadyError(
+                    f"peer {self.peer_info.grpc_address} batch queue full"
+                ) from e
+            await self._connect()
+            return await fut
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            if e.code() in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.CANCELLED,
+            ):
+                raise PeerNotReadyError(str(e)) from e
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def update_peer_globals(
+        self, globals_: List[UpdatePeerGlobal]
+    ) -> None:
+        """Owner->peer authoritative GLOBAL status push
+        (peer_client.go:245-268)."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        self._track_inflight(+1)
+        try:
+            stub = await self._connect()
+            req = peers_pb2.UpdatePeerGlobalsReq(
+                globals=[grpc_api.global_to_pb(g) for g in globals_]
+            )
+            await stub.UpdatePeerGlobals(
+                req, timeout=self.behavior.batch_timeout_s
+            )
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def shutdown(self) -> None:
+        """Stop accepting work, wait for in-flight requests to drain, then
+        close the channel (peer_client.go:512-546)."""
+        self._shutdown = True
+        await self._drained.wait()
+        if self._batcher_task is not None:
+            self._batcher_task.cancel()
+            try:
+                await self._batcher_task
+            except asyncio.CancelledError:
+                pass
+            self._batcher_task = None
+        # Fail anything still queued.
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(PeerNotReadyError("peer shut down"))
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._stub = None
+
+    # -- health ----------------------------------------------------------
+    def last_errors(self) -> List[str]:
+        """Errors seen in the trailing window, for HealthCheck
+        (peer_client.go:271-300)."""
+        cutoff = time.monotonic() - ERROR_WINDOW_S
+        return [msg for ts, msg in self._errors if ts >= cutoff]
+
+    def _record_error(self, msg: str) -> None:
+        self._errors.append((time.monotonic(), msg))
+
+    def _track_inflight(self, delta: int) -> None:
+        self._inflight += delta
+        if self._inflight == 0:
+            self._drained.set()
+        else:
+            self._drained.clear()
+
+    # -- batcher ---------------------------------------------------------
+    async def _run_batcher(self) -> None:
+        """Flush loop: first item opens a `batch_wait` window; the batch
+        ships when the window closes or `batch_limit` items are pending
+        (peer_client.go:373-446, interval.go:29-72 one-shot ticker)."""
+        wait_s = self.behavior.batch_wait_s
+        limit = self.behavior.batch_limit
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = time.monotonic() + wait_s
+            while len(batch) < limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining
+                    )
+                except asyncio.TimeoutError:
+                    break
+                batch.append(item)
+            asyncio.ensure_future(self._send_batch(batch))
+
+    async def _send_batch(
+        self, batch: List[Tuple[RateLimitReq, asyncio.Future]]
+    ) -> None:
+        """One RPC for the whole batch; responses map back by position
+        (peer_client.go:450-509)."""
+        reqs = [r for r, _ in batch]
+        try:
+            resps = await self._call_get_peer_rate_limits(reqs)
+            if len(resps) != len(batch):
+                raise PeerNotReadyError(
+                    "peer returned %d responses for %d requests"
+                    % (len(resps), len(batch))
+                )
+            for (_, fut), resp in zip(batch, resps):
+                if not fut.done():
+                    fut.set_result(resp)
+        except Exception as e:  # noqa: BLE001 — propagate to all waiters
+            self._record_error(str(e))
+            err: Exception = e
+            if isinstance(e, grpc.aio.AioRpcError) and e.code() in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.CANCELLED,
+            ):
+                err = PeerNotReadyError(str(e))
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(err)
+
+    async def _call_get_peer_rate_limits(
+        self, reqs: List[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        stub = await self._connect()
+        pb_req = peers_pb2.GetPeerRateLimitsReq(
+            requests=[grpc_api.req_to_pb(r) for r in reqs]
+        )
+        pb_resp = await stub.GetPeerRateLimits(
+            pb_req, timeout=self.behavior.batch_timeout_s
+        )
+        return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

@@ -1,0 +1,176 @@
+"""TLS subsystem: server/client credentials, mTLS, and AutoTLS.
+
+Re-expresses the reference TLS feature set (tls.go:46-444,
+config.go:338-368) for python gRPC + aiohttp:
+
+- server TLS from cert/key files;
+- mutual TLS with the four client-auth modes (request, require-any,
+  verify-if-given, require-and-verify);
+- AutoTLS: when no certs are configured, generate an in-memory CA and a
+  server certificate for localhost/hostname (tls.go:59-62's self-signed
+  path) so TLS "just works" in dev clusters;
+- client-side credentials with optional insecure_skip_verify.
+
+gRPC python cannot express "request but don't require" client certs, so the
+four Go modes collapse onto require_client_auth True/False pairs — the
+verifying modes verify against the configured (or generated) CA.
+"""
+from __future__ import annotations
+
+import datetime
+import ssl
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import grpc
+
+from gubernator_tpu.core.config import TLSConfig
+
+
+@dataclass
+class TLSBundle:
+    """Materialized credential set for one daemon."""
+
+    ca_pem: bytes
+    cert_pem: bytes
+    key_pem: bytes
+    client_auth: str = ""
+    insecure_skip_verify: bool = False
+
+    def server_credentials(self) -> grpc.ServerCredentials:
+        require = self.client_auth in ("require", "verify")
+        return grpc.ssl_server_credentials(
+            [(self.key_pem, self.cert_pem)],
+            root_certificates=self.ca_pem if require else None,
+            require_client_auth=require,
+        )
+
+    def client_credentials(self) -> grpc.ChannelCredentials:
+        # For skip-verify we still need *a* root; gRPC has no insecure-TLS
+        # mode, so trust our own CA bundle (dev clusters share the CA).
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca_pem,
+            private_key=self.key_pem,
+            certificate_chain=self.cert_pem,
+        )
+
+    def client_ssl_context(self) -> ssl.SSLContext:
+        """aiohttp/HTTP-gateway client context."""
+        ctx = ssl.create_default_context(
+            cadata=self.ca_pem.decode()
+        )
+        if self.insecure_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def server_ssl_context(self) -> ssl.SSLContext:
+        """aiohttp/HTTP-gateway server context (needs temp files for
+        load_cert_chain)."""
+        import tempfile
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+            cf.write(self.cert_pem)
+            cf.flush()
+            kf.write(self.key_pem)
+            kf.flush()
+            ctx.load_cert_chain(cf.name, kf.name)
+        if self.client_auth in ("require", "verify"):
+            ctx.load_verify_locations(cadata=self.ca_pem.decode())
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+
+def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
+    """Materialize a TLSBundle from config, generating AutoTLS credentials
+    when no cert files are given (SetupTLS, tls.go:140-238)."""
+    if cfg is None:
+        return None
+    if cfg.cert_file and cfg.key_file:
+        cert_pem = open(cfg.cert_file, "rb").read()
+        key_pem = open(cfg.key_file, "rb").read()
+        ca_pem = (
+            open(cfg.ca_file, "rb").read() if cfg.ca_file else cert_pem
+        )
+        return TLSBundle(
+            ca_pem=ca_pem,
+            cert_pem=cert_pem,
+            key_pem=key_pem,
+            client_auth=cfg.client_auth,
+            insecure_skip_verify=cfg.insecure_skip_verify,
+        )
+    ca_pem, ca_key, cert_pem, key_pem = generate_auto_tls()
+    return TLSBundle(
+        ca_pem=ca_pem,
+        cert_pem=cert_pem,
+        key_pem=key_pem,
+        client_auth=cfg.client_auth,
+        insecure_skip_verify=cfg.insecure_skip_verify,
+    )
+
+
+def generate_auto_tls(
+    hostnames: Tuple[str, ...] = ("localhost",),
+) -> Tuple[bytes, bytes, bytes, bytes]:
+    """Generate (ca_pem, ca_key_pem, server_cert_pem, server_key_pem) for
+    dev/test TLS — the AutoTLS path (tls.go:59-62, 240-329)."""
+    import ipaddress
+    import socket
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    def make_key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ca_key = make_key()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "gubernator-tpu-dev-ca")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    srv_key = make_key()
+    sans = [x509.DNSName(h) for h in hostnames]
+    sans.append(x509.DNSName(socket.gethostname()))
+    sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    srv_cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, hostnames[0])]
+            )
+        )
+        .issuer_name(ca_name)
+        .public_key(srv_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    pem = serialization.Encoding.PEM
+    pk8 = serialization.PrivateFormat.PKCS8
+    nenc = serialization.NoEncryption()
+    return (
+        ca_cert.public_bytes(pem),
+        ca_key.private_bytes(pem, pk8, nenc),
+        srv_cert.public_bytes(pem),
+        srv_key.private_bytes(pem, pk8, nenc),
+    )

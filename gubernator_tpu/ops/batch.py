@@ -77,6 +77,7 @@ def pack_requests_grid(
     n_shards: int,
     shard_fn,
     clock: Optional[clock_mod.Clock] = None,
+    use_cached: Optional[Sequence[bool]] = None,
 ) -> PackedGrid:
     """Pack requests into rounds of fixed-shape [n_shards, batch_size] arrays.
 
@@ -103,11 +104,13 @@ def pack_requests_grid(
     per_round: List[List[List[Tuple[int, RateLimitReq]]]] = []
     shard_cache: Dict[str, int] = {}
     for i, r in enumerate(reqs):
-        if not r.name:
-            errors[i] = "field 'name' cannot be empty"
-            continue
+        # Validation order + messages match gubernator.go:228-237 (note the
+        # reference reports an empty name as 'namespace').
         if not r.unique_key:
             errors[i] = "field 'unique_key' cannot be empty"
+            continue
+        if not r.name:
+            errors[i] = "field 'namespace' cannot be empty"
             continue
         key = r.hash_key()
         shard = shard_cache.get(key)
@@ -135,7 +138,10 @@ def pack_requests_grid(
         for shard, entries in enumerate(shards):
             for lane, (i, r) in enumerate(entries):
                 positions[i] = (rnd_idx, shard, lane)
-                err = _fill_lane(batches[shard], lane, r, now_dt)
+                err = _fill_lane(
+                    batches[shard], lane, r, now_dt,
+                    bool(use_cached[i]) if use_cached is not None else False,
+                )
                 if err is not None:
                     errors[i] = err
                     positions[i] = (-1, -1, -1)
@@ -156,9 +162,12 @@ def pack_requests(
     reqs: Sequence[RateLimitReq],
     batch_size: int,
     clock: Optional[clock_mod.Clock] = None,
+    use_cached: Optional[Sequence[bool]] = None,
 ) -> PackedRounds:
     """Single-shard packing: the n_shards=1 view of pack_requests_grid."""
-    grid = pack_requests_grid(reqs, batch_size, 1, lambda key: 0, clock)
+    grid = pack_requests_grid(
+        reqs, batch_size, 1, lambda key: 0, clock, use_cached
+    )
     return PackedRounds(
         rounds=[DeviceBatch(*[a[0] for a in rb]) for rb in grid.rounds],
         positions=[
@@ -187,7 +196,13 @@ def _empty_batch(batch_size: int) -> DeviceBatch:
     )
 
 
-def _fill_lane(b: DeviceBatch, lane: int, r: RateLimitReq, now_dt) -> Optional[str]:
+def _fill_lane(
+    b: DeviceBatch,
+    lane: int,
+    r: RateLimitReq,
+    now_dt,
+    use_cached: bool = False,
+) -> Optional[str]:
     is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
     if is_greg:
         try:
@@ -205,6 +220,7 @@ def _fill_lane(b: DeviceBatch, lane: int, r: RateLimitReq, now_dt) -> Optional[s
     b.reset_remaining[lane] = has_behavior(r.behavior, Behavior.RESET_REMAINING)
     b.is_greg[lane] = is_greg
     b.active[lane] = True
+    b.use_cached[lane] = use_cached
     return None
 
 

@@ -397,6 +397,90 @@ apply_batch = jax.jit(
 )
 
 
+class BucketRows(NamedTuple):
+    """A batch of full bucket rows for bulk upsert — the device side of the
+    Loader restore stream (workers.go:340-426) and of Store.Get seeding
+    (algorithms.go:45-51).  key_hash 0 = inactive lane."""
+
+    key_hash: jax.Array    # int64[B]
+    algo: jax.Array        # int32[B]
+    limit: jax.Array       # int64[B]
+    duration: jax.Array    # int64[B]
+    remaining: jax.Array   # int64[B]
+    remaining_f: jax.Array  # float64[B]
+    t0: jax.Array          # int64[B]
+    status: jax.Array      # int32[B]
+    burst: jax.Array       # int64[B]
+    expire_at: jax.Array   # int64[B]
+
+
+def load_rows_impl(
+    table: SlotTable,
+    rows: BucketRows,
+    now: jax.Array,
+    ways: int = 8,
+) -> SlotTable:
+    """Upsert full bucket rows (KIND_BUCKET).  Keys unique within the batch."""
+    S = table.key.shape[0]
+    now = jnp.asarray(now, dtype=jnp.int64)
+    active = rows.key_hash != 0
+    _, persist, slot, _ = locate_slots(table, rows.key_hash, active, now, ways)
+    do_write = persist & active
+    tgt = jnp.where(do_write, slot, S)
+
+    def scat(arr, val):
+        return arr.at[tgt].set(val.astype(arr.dtype), mode="drop")
+
+    return SlotTable(
+        key=scat(table.key, rows.key_hash),
+        algo=scat(table.algo, rows.algo),
+        kind=scat(table.kind, jnp.full_like(rows.algo, KIND_BUCKET)),
+        limit=scat(table.limit, rows.limit),
+        duration=scat(table.duration, rows.duration),
+        remaining=scat(table.remaining, rows.remaining),
+        remaining_f=scat(table.remaining_f, rows.remaining_f),
+        t0=scat(table.t0, rows.t0),
+        status=scat(table.status, rows.status),
+        burst=scat(table.burst, rows.burst),
+        expire_at=scat(table.expire_at, rows.expire_at),
+        touched=scat(table.touched, jnp.full_like(rows.key_hash, now)),
+    )
+
+
+load_rows = jax.jit(
+    load_rows_impl, static_argnames=("ways",), donate_argnums=(0,)
+)
+
+
+def probe_batch_impl(
+    table: SlotTable,
+    h: jax.Array,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Read-only batched lookup: (found, slot) per lane.
+
+    The batched analog of a cache-miss test (lrucache.go:111-127) — used by
+    the Store write-through path to find which keys need `Store.Get` seeding
+    before a batch, and to read back written rows for `Store.OnChange`.
+    """
+    S = table.key.shape[0]
+    nb = S // ways
+    bucket = (h.astype(jnp.uint64) & jnp.uint64(nb - 1)).astype(jnp.int64)
+    sidx = bucket[:, None] * ways + jnp.arange(ways, dtype=jnp.int64)[None, :]
+    match = (
+        (table.key[sidx] == h[:, None])
+        & (h[:, None] != 0)
+        & (table.expire_at[sidx] > now)
+    )
+    found = match.any(axis=1)
+    slot = bucket * ways + jnp.argmax(match, axis=1)
+    return found, jnp.where(found, slot, 0)
+
+
+probe_batch = jax.jit(probe_batch_impl, static_argnames=("ways",))
+
+
 class CachedRows(NamedTuple):
     """A batch of owner-broadcast statuses (UpdatePeerGlobal rows,
     peers.proto:52-56): key fingerprint + the authoritative RateLimitResp."""

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import Dict, List, NamedTuple, Optional, Sequence
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -32,7 +33,15 @@ from gubernator_tpu.core.types import (
 )
 from gubernator_tpu.ops.batch import DeviceBatch, pack_requests
 from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
-from gubernator_tpu.ops.step import DeviceBatchJ, apply_batch
+from gubernator_tpu.ops.step import (
+    BucketRows,
+    CachedRows,
+    DeviceBatchJ,
+    apply_batch,
+    load_rows,
+    probe_batch,
+    store_cached_rows_impl,
+)
 
 
 class DeviceBackend:
@@ -42,7 +51,11 @@ class DeviceBackend:
         self,
         cfg: Optional[DeviceConfig] = None,
         clock: Optional[clock_mod.Clock] = None,
+        store: Optional["Store"] = None,
+        track_keys: bool = False,
+        metrics=None,
     ) -> None:
+        self.metrics = metrics
         self.cfg = cfg or DeviceConfig()
         self.clock = clock or clock_mod.default_clock()
         self._lock = threading.Lock()
@@ -53,6 +66,18 @@ class DeviceBackend:
         with jax.default_device(self._device):
             self.table: SlotTable = init_table(self.cfg.num_slots)
         self._step = functools.partial(apply_batch, ways=self.cfg.ways)
+        self._load_rows = functools.partial(load_rows, ways=self.cfg.ways)
+        self._probe = functools.partial(probe_batch, ways=self.cfg.ways)
+        self._store_cached = jax.jit(
+            functools.partial(store_cached_rows_impl, ways=self.cfg.ways),
+            donate_argnums=(0,),
+        )
+        self.store = store
+        # fingerprint -> hash-key string, maintained when persistence needs
+        # to reconstruct key strings from device rows (save path).
+        self._keymap: Optional[Dict[int, str]] = (
+            {} if (store is not None or track_keys) else None
+        )
         # Running totals (metric parity: gubernator_over_limit_counter etc.)
         self.checks = 0
         self.over_limit = 0
@@ -63,31 +88,320 @@ class DeviceBackend:
             self.checks += tally.checks
             self.over_limit += tally.over_limit
             self.not_persisted += tally.not_persisted
+        m = self.metrics
+        if m is not None:
+            m.check_counter.inc(tally.checks)
+            if tally.over_limit:
+                m.over_limit_counter.inc(tally.over_limit)
+            if tally.not_persisted:
+                m.unexpired_evictions.inc(tally.not_persisted)
+            m.cache_access_count.labels(type="hit").inc(tally.cache_hits)
+            m.cache_access_count.labels(type="miss").inc(
+                tally.checks - tally.cache_hits
+            )
 
     # -- hot path --------------------------------------------------------
-    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+    def check(
+        self,
+        reqs: Sequence[RateLimitReq],
+        use_cached: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
         """Apply a list of checks; returns responses in request order.
 
         The packer splits duplicate keys into sequential rounds so same-key
         requests observe each other's effects, like the reference's per-key
         worker serialization (workers.go:182-186).
-        """
-        packed = pack_requests(reqs, self.cfg.batch_size, self.clock)
-        now = self.clock.millisecond_now()
 
+        `use_cached[i]` marks request i to serve a live GLOBAL broadcast row
+        verbatim (the non-owner read path, gubernator.go:434-447).
+        """
+        packed = pack_requests(
+            reqs, self.cfg.batch_size, self.clock, use_cached
+        )
+        now = self.clock.millisecond_now()
+        if self._keymap is not None:
+            for i, r in enumerate(reqs):
+                if i not in packed.errors:
+                    k = r.hash_key()
+                    self._keymap[key_hash64(k)] = k
+            self._maybe_prune_keymap()
         round_resps = []
+        t_start = time.monotonic()
         with self._lock:
+            if self.store is not None:
+                self._seed_from_store(reqs, packed, now)
             for db in packed.rounds:
                 self.table, resp = self._step(
                     self.table, _to_device(db), np.int64(now)
                 )
                 round_resps.append(resp)
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
+            self.metrics.pool_queue_length.observe(len(reqs))
         # One sync at the end of all rounds.
         out, tally = unmarshal_responses(
             len(reqs), packed.errors, packed.positions,
             resp_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
+        if self.store is not None:
+            self._write_through(reqs, packed, out, use_cached)
+        return out
+
+    def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
+        """found-mask for a host hash vector, probing in fixed batch_size
+        chunks so the jitted probe never sees a new shape (the fixed-shape
+        rule, core/config.py DeviceConfig)."""
+        B = self.cfg.batch_size
+        out = np.zeros(len(hashes), dtype=bool)
+        for lo in range(0, len(hashes), B):
+            chunk = hashes[lo:lo + B]
+            padded = np.zeros(B, dtype=np.int64)
+            padded[: len(chunk)] = chunk
+            found, _ = self._probe(self.table, padded, np.int64(now))
+            out[lo:lo + len(chunk)] = np.asarray(found)[: len(chunk)]
+        return out
+
+    def _maybe_prune_keymap(self) -> None:
+        """Bound the fingerprint->key map: the table holds at most num_slots
+        live rows, so once the map is 4x that, drop fingerprints no longer
+        resident (evicted/expired keys would otherwise accumulate forever).
+        """
+        assert self._keymap is not None
+        if len(self._keymap) <= max(4 * self.cfg.num_slots, 65_536):
+            return
+        with self._lock:
+            resident = set(
+                np.asarray(self.table.key).view(np.uint64).tolist()
+            )
+        self._keymap = {
+            fp: k for fp, k in self._keymap.items() if fp in resident
+        }
+
+    # -- store write-through ---------------------------------------------
+    def _seed_from_store(self, reqs, packed, now: int) -> None:
+        """Consult Store.get for batch keys not resident on device and bulk
+        upsert the hits (the batched analog of algorithms.go:45-51)."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
+        uniq: Dict[str, RateLimitReq] = {}
+        for i, r in enumerate(reqs):
+            if i not in packed.errors:
+                uniq.setdefault(r.hash_key(), r)
+        keys = list(uniq.keys())
+        if not keys:
+            return
+        hashes = np.array(
+            [np.uint64(key_hash64(k)) for k in keys], dtype=np.uint64
+        ).view(np.int64)
+        found = self._probe_padded(hashes, now)
+        rows: List[dict] = []
+        row_hashes: List[int] = []
+        for j, (k, f) in enumerate(zip(keys, found)):
+            if f:
+                continue
+            item = self.store.get(uniq[k])
+            if item is None or item.is_expired(now):
+                continue
+            rows.append(item_to_row_fields(item))
+            row_hashes.append(int(hashes[j]))
+        if not rows:
+            return
+        B = self.cfg.batch_size
+        for lo in range(0, len(rows), B):
+            chunk = rows[lo:lo + B]
+            pad = B - len(chunk)
+            br = BucketRows(
+                key_hash=np.array(
+                    row_hashes[lo:lo + B] + [0] * pad, dtype=np.int64
+                ),
+                **{
+                    f: np.array(
+                        [c[f] for c in chunk] + [0] * pad,
+                        dtype=np.float64 if f == "remaining_f" else (
+                            np.int32 if f in ("algo", "status") else np.int64
+                        ),
+                    )
+                    for f in (
+                        "algo", "limit", "duration", "remaining",
+                        "remaining_f", "t0", "status", "burst", "expire_at",
+                    )
+                },
+            )
+            self.table = self._load_rows(self.table, br, np.int64(now))
+
+    def read_items_bulk(
+        self, keys: Sequence[str], include_cached: bool = False
+    ) -> Dict[str, CacheItem]:
+        """Batched point-reads: probe + device-side row gather in fixed-size
+        chunks, one host sync per chunk.  KIND_CACHED_RESP rows (GLOBAL
+        broadcast cache, not bucket state) are skipped unless asked for."""
+        from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
+        B = self.cfg.batch_size
+        now = self.clock.millisecond_now()
+        hashes = np.array(
+            [np.uint64(key_hash64(k)) for k in keys], dtype=np.uint64
+        ).view(np.int64)
+        out: Dict[str, CacheItem] = {}
+        with self._lock:
+            for lo in range(0, len(keys), B):
+                chunk_keys = keys[lo:lo + B]
+                padded = np.zeros(B, dtype=np.int64)
+                padded[: len(chunk_keys)] = hashes[lo:lo + B]
+                found, slot = self._probe(self.table, padded, np.int64(now))
+                rows = {
+                    f: np.asarray(getattr(self.table, f)[slot])
+                    for f in self.table._fields
+                }
+                found = np.asarray(found)
+                for j, k in enumerate(chunk_keys):
+                    if not found[j]:
+                        continue
+                    if (
+                        rows["kind"][j] == KIND_CACHED_RESP
+                        and not include_cached
+                    ):
+                        continue
+                    out[k] = _row_to_item(rows, j, k)
+        return out
+
+    def _write_through(self, reqs, packed, resps, use_cached=None) -> None:
+        """Read back post-step rows for persisted requests and hand them to
+        Store.on_change (the batched analog of algorithms.go:154-158).
+
+        Lanes served from GLOBAL broadcast cache (use_cached) are excluded —
+        their rows are replicated responses, not authoritative bucket state
+        (the reference only runs OnChange inside the owner's algorithm)."""
+        seen: set = set()
+        key_req: List[Tuple[str, RateLimitReq]] = []
+        for i, r in enumerate(reqs):
+            if i in packed.errors:
+                continue
+            if use_cached is not None and use_cached[i]:
+                continue
+            key = r.hash_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            key_req.append((key, r))
+        if not key_req:
+            return
+        items = self.read_items_bulk([k for k, _ in key_req])
+        for key, r in key_req:
+            item = items.get(key)
+            if item is not None:
+                self.store.on_change(r, item)
+
+    # -- GLOBAL broadcast receive ----------------------------------------
+    def apply_cached_rows(self, rows: List[tuple]) -> None:
+        """Upsert owner-broadcast statuses: rows of
+        (hash_key_str, algorithm, limit, remaining, status, reset_time) —
+        the UpdatePeerGlobals receive path (gubernator.go:464-479)."""
+        if not rows:
+            return
+        if self._keymap is not None:
+            for key, *_ in rows:
+                self._keymap[key_hash64(key)] = key
+        B = self.cfg.batch_size
+        now = self.clock.millisecond_now()
+        with self._lock:
+            for lo in range(0, len(rows), B):
+                chunk = rows[lo:lo + B]
+                pad = B - len(chunk)
+                cr = CachedRows(
+                    key_hash=np.array(
+                        [np.uint64(key_hash64(k)).view(np.int64)
+                         for k, *_ in chunk] + [0] * pad,
+                        dtype=np.int64,
+                    ),
+                    algo=np.array(
+                        [c[1] for c in chunk] + [0] * pad, dtype=np.int32
+                    ),
+                    limit=np.array(
+                        [c[2] for c in chunk] + [0] * pad, dtype=np.int64
+                    ),
+                    remaining=np.array(
+                        [c[3] for c in chunk] + [0] * pad, dtype=np.int64
+                    ),
+                    status=np.array(
+                        [c[4] for c in chunk] + [0] * pad, dtype=np.int32
+                    ),
+                    reset_time=np.array(
+                        [c[5] for c in chunk] + [0] * pad, dtype=np.int64
+                    ),
+                )
+                self.table = self._store_cached(self.table, cr, np.int64(now))
+
+    # -- Loader bulk load/save -------------------------------------------
+    def load_items(self, items) -> int:
+        """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
+        B = self.cfg.batch_size
+        now = self.clock.millisecond_now()
+        n = 0
+        batch_rows: List[dict] = []
+        batch_hashes: List[int] = []
+
+        def flush() -> None:
+            pad = B - len(batch_rows)
+            br = BucketRows(
+                key_hash=np.array(batch_hashes + [0] * pad, dtype=np.int64),
+                **{
+                    f: np.array(
+                        [c[f] for c in batch_rows] + [0] * pad,
+                        dtype=np.float64 if f == "remaining_f" else (
+                            np.int32 if f in ("algo", "status") else np.int64
+                        ),
+                    )
+                    for f in (
+                        "algo", "limit", "duration", "remaining",
+                        "remaining_f", "t0", "status", "burst", "expire_at",
+                    )
+                },
+            )
+            with self._lock:
+                self.table = self._load_rows(self.table, br, np.int64(now))
+            batch_rows.clear()
+            batch_hashes.clear()
+
+        for item in items:
+            if self._keymap is not None:
+                self._keymap[key_hash64(item.key)] = item.key
+            batch_rows.append(item_to_row_fields(item))
+            batch_hashes.append(
+                int(np.uint64(key_hash64(item.key)).view(np.int64))
+            )
+            n += 1
+            if len(batch_rows) == B:
+                flush()
+        if batch_rows:
+            flush()
+        return n
+
+    def live_items(self) -> List[CacheItem]:
+        """All live rows as CacheItems (Loader save, workers.go:467-530).
+        Requires key tracking (a Store/Loader attached at construction)."""
+        if self._keymap is None:
+            raise RuntimeError(
+                "live_items() needs key tracking; construct the backend with "
+                "a store or track_keys=True"
+            )
+        snap = self.snapshot()
+        now = self.clock.millisecond_now()
+        out: List[CacheItem] = []
+        live = np.flatnonzero(
+            (snap["key"] != 0) & (snap["expire_at"] > now)
+        )
+        for s in live:
+            fp = int(np.int64(snap["key"][s]).view(np.uint64))
+            key = self._keymap.get(fp)
+            if key is None:
+                continue
+            out.append(_row_to_item(snap, s, key))
         return out
 
     # -- cache item access (GLOBAL path + persistence SPI) ---------------
@@ -118,6 +432,7 @@ class Tally(NamedTuple):
     checks: int
     over_limit: int
     not_persisted: int
+    cache_hits: int = 0
 
 
 def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
@@ -129,6 +444,7 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "reset_time": np.asarray(r.reset_time),
             "limit": np.asarray(r.limit),
             "persisted": np.asarray(r.persisted),
+            "found": np.asarray(r.found),
         }
         for r in round_resps
     ]
@@ -147,7 +463,7 @@ def unmarshal_responses(
     for the mesh backend.  Returns (responses, Tally).
     """
     out: List[RateLimitResp] = []
-    checks = over = notp = 0
+    checks = over = notp = hits = 0
     for i in range(n_reqs):
         err = errors.get(i)
         if err is not None:
@@ -168,7 +484,9 @@ def unmarshal_responses(
             over += 1
         if not r["persisted"][idx]:
             notp += 1
-    return out, Tally(checks, over, notp)
+        if r["found"][idx]:
+            hits += 1
+    return out, Tally(checks, over, notp, hits)
 
 
 def probe_bucket(

@@ -1,0 +1,149 @@
+"""Prometheus metrics — full parity with the reference catalog
+(reference prometheus.md:17-36; definitions gubernator.go:59-113,
+lrucache.go:48-59, global.go:48-57, grpc_stats.go:51-63), plus TPU-specific
+gauges for the device engine (slot occupancy, device step latency).
+
+All collectors live on a private registry (like the daemon's private
+prometheus registry, daemon.go:85-99) so multiple daemons can share one
+process in tests — the in-process cluster fixture depends on this.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Summary,
+    generate_latest,
+)
+
+
+class Metrics:
+    """One bundle of collectors per daemon."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+
+        # -- request path (gubernator.go:59-113) -------------------------
+        self.check_counter = Counter(
+            "gubernator_check_counter",
+            "The number of rate limits checked.",
+            registry=r,
+        )
+        self.check_error_counter = Counter(
+            "gubernator_check_error_counter",
+            "The number of errors while checking rate limits.",
+            ["error"],
+            registry=r,
+        )
+        self.over_limit_counter = Counter(
+            "gubernator_over_limit_counter",
+            "The number of rate limit checks that are over the limit.",
+            registry=r,
+        )
+        self.getratelimit_counter = Counter(
+            "gubernator_getratelimit_counter",
+            "The count of getRateLimit() calls.",
+            ["calltype"],  # local | forward | global
+            registry=r,
+        )
+        self.concurrent_checks = Summary(
+            "gubernator_concurrent_checks_counter",
+            "Concurrent rate checks in flight.",
+            registry=r,
+        )
+        self.func_duration = Summary(
+            "gubernator_func_duration",
+            "Timings of key functions in seconds.",
+            ["name"],
+            registry=r,
+        )
+        self.asyncrequest_retries = Counter(
+            "gubernator_asyncrequest_retries",
+            "Retries in forwarding a request to another peer.",
+            ["name"],
+            registry=r,
+        )
+
+        # -- batching / peer traffic (peer_client, workers) ---------------
+        self.batch_send_duration = Summary(
+            "gubernator_batch_send_duration",
+            "Timings of batch sends to a remote peer.",
+            ["peerAddr"],
+            registry=r,
+        )
+        self.queue_length = Summary(
+            "gubernator_queue_length",
+            "Remote-batch queue length at send time.",
+            ["peerAddr"],
+            registry=r,
+        )
+        self.pool_queue_length = Summary(
+            "gubernator_pool_queue_length",
+            "Local device-batch sizes per step (the worker-pool queue "
+            "analog).",
+            registry=r,
+        )
+
+        # -- GLOBAL replication (global.go:48-57) -------------------------
+        self.async_durations = Summary(
+            "gubernator_async_durations",
+            "Timings of GLOBAL async sends in seconds.",
+            registry=r,
+        )
+        self.broadcast_durations = Summary(
+            "gubernator_broadcast_durations",
+            "Timings of GLOBAL broadcasts to peers in seconds.",
+            registry=r,
+        )
+
+        # -- cache / device table (lrucache.go:48-59) ---------------------
+        self.cache_access_count = Counter(
+            "gubernator_cache_access_count",
+            "Slot-table accesses during rate checks.",
+            ["type"],  # hit | miss
+            registry=r,
+        )
+        self.cache_size = Gauge(
+            "gubernator_cache_size",
+            "Live items in the device slot table.",
+            registry=r,
+        )
+        self.unexpired_evictions = Counter(
+            "gubernator_unexpired_evictions_count",
+            "Live items evicted early (victim claim over a live slot).",
+            registry=r,
+        )
+
+        # -- gRPC server (grpc_stats.go:51-63) ----------------------------
+        self.grpc_request_counts = Counter(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC requests.",
+            ["method", "failed"],
+            registry=r,
+        )
+        self.grpc_request_duration = Summary(
+            "gubernator_grpc_request_duration",
+            "Timings of gRPC requests in seconds.",
+            ["method"],
+            registry=r,
+        )
+
+        # -- TPU-specific -------------------------------------------------
+        self.device_step_duration = Summary(
+            "gubernator_tpu_device_step_duration",
+            "Wall time of one jitted device batch step in seconds.",
+            registry=r,
+        )
+        self.device_occupancy = Gauge(
+            "gubernator_tpu_slot_occupancy",
+            "Occupied slots in the device table.",
+            registry=r,
+        )
+
+    def render(self) -> bytes:
+        """Text exposition for the /metrics endpoint."""
+        return generate_latest(self.registry)

@@ -1,0 +1,688 @@
+"""The service instance: per-request routing over the cluster.
+
+This is the analog of the reference's V1Instance (gubernator.go:46-824) — the
+"brain" that decides, for every rate-limit check, whether to answer from the
+local device engine, serve a GLOBAL key from replicated cache, or forward to
+the owning peer.  One deliberate TPU-first difference: where the reference
+dispatches each request to a worker goroutine individually
+(gubernator.go:222-300), this service partitions a client batch ONCE and
+applies all locally-owned checks in a single device step — the request fan
+becomes vector lanes, not goroutines.
+
+Routing per request (gubernator.go:222-300):
+  - validation errors answer inline (handled by the packer);
+  - owner == us      -> local device batch;
+  - GLOBAL, not ours -> local device batch with the use_cached lane flag
+                        (stale-but-fast read, gubernator.go:420-460) + hit
+                        queued to the global manager; metadata["owner"] set;
+  - otherwise        -> forwarded to the owner through the batching peer
+                        client with <=5 retries on ownership change
+                        (gubernator.go:327-416).
+
+The GlobalManager re-implements global.go:33-254 on asyncio: an async-hits
+loop aggregating (key -> summed hits) flushed to owners every
+`global_sync_wait`, and a broadcast loop pushing owner-authoritative statuses
+to every peer with the GLOBAL flag cleared to avoid loops (global.go:214-215).
+
+The MultiRegionManager implements the cross-region tier the reference leaves
+stubbed (multiregion.go:96-98 "Does nothing for now"): hits aggregate per key
+and flush to the key's owner in every OTHER region with the MULTI_REGION flag
+cleared (same loop-prevention trick as GLOBAL broadcasts), giving each region
+an eventually-consistent view of cross-region hit pressure over DCN.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import Config, MAX_BATCH_SIZE
+from gubernator_tpu.core.types import (
+    Behavior,
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    UpdatePeerGlobal,
+    has_behavior,
+)
+from gubernator_tpu.net.peer_client import PeerClient, PeerNotReadyError
+from gubernator_tpu.net.replicated_hash import (
+    HASH_FUNCTIONS,
+    PoolEmptyError,
+    RegionPicker,
+    ReplicatedConsistentHash,
+)
+from gubernator_tpu.runtime.backend import DeviceBackend
+
+log = logging.getLogger("gubernator_tpu.service")
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+ASYNC_RETRIES = 5  # forwarded-request ownership-change retries (gubernator.go:350)
+
+
+class ApiError(Exception):
+    """Service-level error with a gRPC status-code name."""
+
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class Service:
+    """The per-node service instance."""
+
+    def __init__(
+        self,
+        cfg: Optional[Config] = None,
+        backend: Optional[DeviceBackend] = None,
+        clock: Optional[clock_mod.Clock] = None,
+        peer_credentials=None,
+        metrics=None,
+    ) -> None:
+        from gubernator_tpu.runtime.metrics import Metrics
+
+        self.cfg = cfg or Config()
+        self.clock = clock or clock_mod.default_clock()
+        self.metrics = metrics or Metrics()
+        self.backend = backend or DeviceBackend(
+            self.cfg.device,
+            clock=self.clock,
+            store=self.cfg.store,
+            track_keys=(self.cfg.loader is not None),
+            metrics=self.metrics,
+        )
+        self._inflight_checks = 0
+        self._peer_credentials = peer_credentials
+        hash_fn = HASH_FUNCTIONS[self.cfg.local_picker_hash]
+        self.local_picker: ReplicatedConsistentHash[PeerClient] = (
+            ReplicatedConsistentHash(hash_fn)
+        )
+        self.region_picker: RegionPicker[PeerClient] = RegionPicker(
+            ReplicatedConsistentHash(
+                HASH_FUNCTIONS[self.cfg.region_picker_hash]
+            )
+        )
+        self._peer_lock = asyncio.Lock()
+        # Single-thread executor serializes blocking device work off the loop
+        # (the whole-table single-writer discipline, workers.go:19-37).
+        self._dev_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-step"
+        )
+        self.global_mgr = GlobalManager(self)
+        self.multi_region_mgr = MultiRegionManager(self)
+        self._closed = False
+        self._started = False
+        if self.cfg.loader is not None:
+            n = self.backend.load_items(self.cfg.loader.load())
+            log.info("loader restored %d items", n)
+
+    async def start(self) -> None:
+        """Start the background replication loops; requires a running event
+        loop (the analog of NewV1Instance spawning the manager goroutines,
+        gubernator.go:137-138)."""
+        if self._started:
+            return
+        self._started = True
+        self.global_mgr.start()
+        self.multi_region_mgr.start()
+        # Warm the jitted device step so the first client request doesn't
+        # pay XLA compilation (20-40s cold) inside an RPC deadline.
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self._dev_executor,
+            lambda: self.backend.check(
+                [RateLimitReq(
+                    name="__warmup__", unique_key="w", hits=0, limit=1,
+                    duration=1,
+                )]
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # peer management
+    # ------------------------------------------------------------------
+    async def set_peers(self, peer_info: Sequence[PeerInfo]) -> None:
+        """Atomically swap in a new peer set and drain removed peers
+        (gubernator.go:634-717)."""
+        local = self.local_picker.new()
+        region = self.region_picker.new()
+        for info in peer_info:
+            if info.data_center != self.cfg.data_center:
+                peer = self.region_picker.get_by_address(info.grpc_address)
+                if peer is None:
+                    peer = self._new_peer(info)
+                region.add(peer, info.data_center)
+            else:
+                peer = self.local_picker.get_by_address(info.grpc_address)
+                if peer is None:
+                    peer = self._new_peer(info)
+                else:
+                    peer.peer_info = info  # refresh is_owner flag
+                local.add(peer)
+
+        async with self._peer_lock:
+            old_local, old_region = self.local_picker, self.region_picker
+            self.local_picker, self.region_picker = local, region
+
+        shutdown: List[PeerClient] = []
+        for peer in old_local.peers():
+            if local.get_by_address(peer.info().grpc_address) is None:
+                shutdown.append(peer)
+        for picker in old_region.pickers().values():
+            for peer in picker.peers():
+                if region.get_by_address(peer.info().grpc_address) is None:
+                    shutdown.append(peer)
+        if shutdown:
+            await asyncio.gather(
+                *(p.shutdown() for p in shutdown), return_exceptions=True
+            )
+            log.debug(
+                "peers shutdown: %s",
+                [p.info().grpc_address for p in shutdown],
+            )
+
+    def _new_peer(self, info: PeerInfo) -> PeerClient:
+        return PeerClient(
+            info,
+            behavior=self.cfg.behaviors,
+            channel_credentials=self._peer_credentials,
+        )
+
+    def get_peer(self, key: str) -> PeerClient:
+        """Owning peer for a hash key (gubernator.go:719-731)."""
+        return self.local_picker.get(key)
+
+    def peer_list(self) -> List[PeerClient]:
+        return self.local_picker.peers()
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    async def get_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """The hot path (gubernator.go:194-310)."""
+        if len(reqs) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels(
+                error="Request too large"
+            ).inc()
+            raise ApiError(
+                "OUT_OF_RANGE",
+                "Requests.RateLimits list too large; max size is '%d'"
+                % MAX_BATCH_SIZE,
+            )
+        self._inflight_checks += 1
+        self.metrics.concurrent_checks.observe(self._inflight_checks)
+        try:
+            return await self._get_rate_limits(reqs)
+        finally:
+            self._inflight_checks -= 1
+
+    async def _get_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        n = len(reqs)
+        responses: List[Optional[RateLimitResp]] = [None] * n
+
+        local_idx: List[int] = []
+        local_cached: List[bool] = []
+        local_owner_meta: List[Optional[str]] = []
+        forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
+
+        single_node = self.local_picker.size() == 0
+        for i, req in enumerate(reqs):
+            # Validation happens in the packer for local requests; forwarded
+            # requests are validated by the owner.  Pre-validate here only to
+            # avoid forwarding junk.
+            key = req.hash_key()
+            if single_node:
+                local_idx.append(i)
+                local_cached.append(False)
+                local_owner_meta.append(None)
+                continue
+            try:
+                peer = self.get_peer(key)
+            except PoolEmptyError as e:
+                responses[i] = RateLimitResp(
+                    error=f"Error in GetPeer, looking up peer that owns "
+                    f"rate limit '{key}': {e}"
+                )
+                continue
+            if peer.info().is_owner:
+                self.metrics.getratelimit_counter.labels("local").inc()
+                local_idx.append(i)
+                local_cached.append(False)
+                local_owner_meta.append(None)
+            elif has_behavior(req.behavior, Behavior.GLOBAL):
+                self.metrics.getratelimit_counter.labels("global").inc()
+                # Serve locally from replicated cache; queue the hit for the
+                # owner (gubernator.go:272-283, 420-460).
+                local_idx.append(i)
+                local_cached.append(True)
+                local_owner_meta.append(peer.info().grpc_address)
+                self.global_mgr.queue_hit(req)
+            else:
+                forwards.append((i, peer, req, key))
+
+        tasks = [
+            asyncio.ensure_future(self._forward(peer, req, key))
+            for (_, peer, req, key) in forwards
+        ]
+
+        if local_idx:
+            local_resps = await self._check_local(
+                [reqs[i] for i in local_idx], local_cached
+            )
+            for j, i in enumerate(local_idx):
+                resp = local_resps[j]
+                if local_owner_meta[j] is not None and not resp.error:
+                    resp.metadata = {"owner": local_owner_meta[j]}
+                responses[i] = resp
+
+        if tasks:
+            results = await asyncio.gather(*tasks)
+            for (i, _, _, _), resp in zip(forwards, results):
+                responses[i] = resp
+
+        return [r if r is not None else RateLimitResp() for r in responses]
+
+    async def _check_local(
+        self,
+        reqs: Sequence[RateLimitReq],
+        use_cached: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
+        """Apply checks on the local device engine; queue GLOBAL owner
+        updates and MULTI_REGION hits (getRateLimit, gubernator.go:600-631).
+        """
+        for r, cached in zip(
+            reqs, use_cached or [False] * len(reqs)
+        ):
+            if cached:
+                continue  # non-owner read path — not authoritative
+            if has_behavior(r.behavior, Behavior.GLOBAL):
+                self.global_mgr.queue_update(r)
+            if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                self.multi_region_mgr.queue_hits(r)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._dev_executor,
+            lambda: self.backend.check(reqs, use_cached),
+        )
+
+    async def _forward(
+        self, peer: PeerClient, req: RateLimitReq, key: str
+    ) -> RateLimitResp:
+        """Forward to the owning peer; on NotReady re-resolve the owner (it
+        may now be us) up to 5 times (asyncRequests, gubernator.go:327-416).
+        """
+        attempts = 0
+        last_err: Optional[Exception] = None
+        while True:
+            if attempts > ASYNC_RETRIES:
+                return RateLimitResp(
+                    error="GetPeer() keeps returning peers that are not "
+                    f"connected for '{key}': {last_err}"
+                )
+            if attempts != 0 and peer.info().is_owner:
+                resps = await self._check_local([req])
+                return resps[0]
+            try:
+                self.metrics.getratelimit_counter.labels("forward").inc()
+                resp = await peer.get_peer_rate_limit(req)
+                resp.metadata = {"owner": peer.info().grpc_address}
+                return resp
+            except PeerNotReadyError as e:
+                last_err = e
+                attempts += 1
+                self.metrics.asyncrequest_retries.labels(req.name).inc()
+                try:
+                    peer = self.get_peer(key)
+                except PoolEmptyError as pe:
+                    return RateLimitResp(
+                        error="Error finding peer that owns rate limit "
+                        f"'{key}': {pe}"
+                    )
+            except Exception as e:  # noqa: BLE001
+                return RateLimitResp(
+                    error=f"Error while fetching rate limit '{key}' "
+                    f"from peer: {e}"
+                )
+
+    # ------------------------------------------------------------------
+    # peer-facing API (server side)
+    # ------------------------------------------------------------------
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """Owner side of a forwarded batch: apply ALL requests in one device
+        step (replacing the reference's goroutine fan-out,
+        gubernator.go:482-543) preserving request order."""
+        if len(reqs) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OUT_OF_RANGE",
+                "'PeerRequest.rate_limits' list too large; max size is '%d'"
+                % MAX_BATCH_SIZE,
+            )
+        return await self._check_local(reqs)
+
+    async def update_peer_globals(
+        self, globals_: Sequence[UpdatePeerGlobal]
+    ) -> None:
+        """Receive owner-authoritative GLOBAL statuses into the local cache
+        (gubernator.go:464-479)."""
+        rows = [
+            (
+                g.key,
+                int(g.algorithm),
+                int(g.status.limit),
+                int(g.status.remaining),
+                int(g.status.status),
+                int(g.status.reset_time),
+            )
+            for g in globals_
+            if g.status is not None
+        ]
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self._dev_executor, lambda: self.backend.apply_cached_rows(rows)
+        )
+
+    # ------------------------------------------------------------------
+    # health / lifecycle
+    # ------------------------------------------------------------------
+    async def health_check(self) -> HealthCheckResp:
+        """Report peer connectivity from the rolling per-peer error windows
+        (gubernator.go:546-598)."""
+        errs: List[str] = []
+        local_peers = self.local_picker.peers()
+        for peer in local_peers:
+            for msg in peer.last_errors():
+                errs.append(
+                    f"Error returned from local peer.GetLastErr: {msg}"
+                )
+        region_peers = self.region_picker.peers()
+        for peer in region_peers:
+            for msg in peer.last_errors():
+                errs.append(
+                    f"Error returned from region peer.GetLastErr: {msg}"
+                )
+        h = HealthCheckResp(
+            status=HEALTHY, peer_count=len(local_peers) + len(region_peers)
+        )
+        if errs:
+            h.status = UNHEALTHY
+            h.message = "|".join(errs)
+        return h
+
+    async def close(self) -> None:
+        """Flush managers, run the Loader save, shut down peers
+        (gubernator.go:159-189)."""
+        if self._closed:
+            return
+        self._closed = True
+        await self.global_mgr.close()
+        await self.multi_region_mgr.close()
+        if self.cfg.loader is not None:
+            loop = asyncio.get_running_loop()
+            items = await loop.run_in_executor(
+                self._dev_executor, self.backend.live_items
+            )
+            self.cfg.loader.save(iter(items))
+        peers = set(self.local_picker.peers()) | set(
+            self.region_picker.peers()
+        )
+        if peers:
+            await asyncio.gather(
+                *(p.shutdown() for p in peers), return_exceptions=True
+            )
+        self._dev_executor.shutdown(wait=True)
+
+
+class GlobalManager:
+    """Async GLOBAL replication loops (global.go:33-254)."""
+
+    def __init__(self, service: Service) -> None:
+        self.s = service
+        cfg = service.cfg.behaviors
+        self.sync_wait_s = cfg.global_sync_wait_s
+        self.batch_limit = cfg.global_batch_limit
+        self.timeout_s = cfg.global_timeout_s
+        self._hits: Dict[str, RateLimitReq] = {}
+        self._updates: Dict[str, RateLimitReq] = {}
+        self._hits_event = asyncio.Event()
+        self._updates_event = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        # Observability counters (scraped by tests for eventual-consistency
+        # assertions, functional_test.go:843-867).
+        self.async_sends = 0
+        self.broadcasts = 0
+
+    def start(self) -> None:
+        if self._tasks:
+            return
+        self._tasks = [
+            asyncio.ensure_future(self._run_async_hits()),
+            asyncio.ensure_future(self._run_broadcasts()),
+        ]
+
+    def queue_hit(self, r: RateLimitReq) -> None:
+        """Aggregate a non-owner hit (summing same-key hits,
+        global.go:87-95)."""
+        key = r.hash_key()
+        cur = self._hits.get(key)
+        if cur is not None:
+            cur.hits += r.hits
+        else:
+            from dataclasses import replace as dc_replace
+
+            self._hits[key] = dc_replace(r)
+        self._hits_event.set()
+
+    def queue_update(self, r: RateLimitReq) -> None:
+        """Record an owner-side status change to broadcast
+        (global.go:167-191; last write per key wins)."""
+        self._updates[r.hash_key()] = r
+        self._updates_event.set()
+
+    async def _run_async_hits(self) -> None:
+        # The first queued hit opens a sync_wait window; everything queued
+        # within it flushes together (interval semantics, global.go:96-119),
+        # split into batch_limit-sized RPCs by _send_hits.
+        while True:
+            await self._hits_event.wait()
+            await asyncio.sleep(self.sync_wait_s)
+            self._hits_event.clear()
+            hits, self._hits = self._hits, {}
+            if hits:
+                await self._send_hits(hits)
+
+    async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        """Group aggregated hits by owning peer and flush
+        (global.go:124-164)."""
+        by_peer: Dict[str, Tuple[PeerClient, List[RateLimitReq]]] = {}
+        for key, r in hits.items():
+            try:
+                peer = self.s.get_peer(key)
+            except PoolEmptyError:
+                continue
+            addr = peer.info().grpc_address
+            by_peer.setdefault(addr, (peer, []))[1].append(r)
+        start = time.monotonic()
+        for peer, batch in by_peer.values():
+            # One RPC per batch_limit-sized slice (the owner rejects batches
+            # over MAX_BATCH_SIZE, gubernator.go:486-490).
+            for lo in range(0, len(batch), self.batch_limit):
+                try:
+                    await asyncio.wait_for(
+                        peer._call_get_peer_rate_limits(
+                            batch[lo:lo + self.batch_limit]
+                        ),
+                        timeout=self.timeout_s,
+                    )
+                    self.async_sends += 1
+                except Exception as e:  # noqa: BLE001
+                    log.error(
+                        "error sending global hits to '%s': %s",
+                        peer.info().grpc_address, e,
+                    )
+        self.s.metrics.async_durations.observe(time.monotonic() - start)
+
+    async def _run_broadcasts(self) -> None:
+        while True:
+            await self._updates_event.wait()
+            await asyncio.sleep(self.sync_wait_s)
+            self._updates_event.clear()
+            updates, self._updates = self._updates, {}
+            if updates:
+                await self._broadcast_peers(updates)
+
+    async def _broadcast_peers(
+        self, updates: Dict[str, RateLimitReq]
+    ) -> None:
+        """Re-read each updated status (hits=0, GLOBAL cleared to avoid
+        re-queueing) and push to every non-owner peer (global.go:205-250)."""
+        from dataclasses import replace as dc_replace
+
+        globals_: List[UpdatePeerGlobal] = []
+        reads = [
+            dc_replace(
+                r, hits=0, behavior=Behavior(int(r.behavior) & ~int(Behavior.GLOBAL))
+            )
+            for r in updates.values()
+        ]
+        try:
+            statuses = await self.s._check_local(reads)
+        except Exception as e:  # noqa: BLE001
+            log.error("while broadcasting update to peers: %s", e)
+            return
+        for r, status in zip(reads, statuses):
+            if status.error:
+                continue
+            globals_.append(
+                UpdatePeerGlobal(
+                    key=r.hash_key(), status=status, algorithm=r.algorithm
+                )
+            )
+        if not globals_:
+            return
+        start = time.monotonic()
+        sent = False
+        for peer in self.s.peer_list():
+            if peer.info().is_owner:
+                continue
+            try:
+                # Chunk to respect the receiver's 1MB message cap.
+                for lo in range(0, len(globals_), self.batch_limit):
+                    await asyncio.wait_for(
+                        peer.update_peer_globals(
+                            globals_[lo:lo + self.batch_limit]
+                        ),
+                        timeout=self.timeout_s,
+                    )
+                sent = True
+            except PeerNotReadyError:
+                continue
+            except Exception as e:  # noqa: BLE001
+                log.error(
+                    "while broadcasting global updates to '%s': %s",
+                    peer.info().grpc_address, e,
+                )
+        if sent:
+            self.broadcasts += 1
+            self.s.metrics.broadcast_durations.observe(
+                time.monotonic() - start
+            )
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+
+class MultiRegionManager:
+    """Cross-region (DCN-tier) hit replication.
+
+    The reference ships only the skeleton — queue + interval loop with a
+    no-op sender (multiregion.go:23-102).  Here the sender works: aggregated
+    hits flush to the key's owner in every OTHER region, with MULTI_REGION
+    cleared on the forwarded copy so receiving regions apply the hits locally
+    instead of re-forwarding (the GLOBAL broadcast loop-prevention pattern,
+    global.go:214-215).  Every region therefore converges on the sum of all
+    regions' hits per key.
+    """
+
+    def __init__(self, service: Service) -> None:
+        self.s = service
+        cfg = service.cfg.behaviors
+        self.sync_wait_s = cfg.multi_region_sync_wait_s
+        self.batch_limit = cfg.multi_region_batch_limit
+        self.timeout_s = cfg.multi_region_timeout_s
+        self._hits: Dict[str, RateLimitReq] = {}
+        self._event = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.region_sends = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    def queue_hits(self, r: RateLimitReq) -> None:
+        key = r.hash_key()
+        cur = self._hits.get(key)
+        if cur is not None:
+            cur.hits += r.hits
+        else:
+            from dataclasses import replace as dc_replace
+
+            self._hits[key] = dc_replace(r)
+        self._event.set()
+
+    async def _run(self) -> None:
+        while True:
+            await self._event.wait()
+            await asyncio.sleep(self.sync_wait_s)
+            self._event.clear()
+            hits, self._hits = self._hits, {}
+            if hits:
+                await self._send_hits(hits)
+
+    async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        from dataclasses import replace as dc_replace
+
+        by_peer: Dict[str, Tuple[PeerClient, List[RateLimitReq]]] = {}
+        for key, r in hits.items():
+            fwd = dc_replace(
+                r,
+                behavior=Behavior(
+                    int(r.behavior) & ~int(Behavior.MULTI_REGION)
+                ),
+            )
+            for peer in self.s.region_picker.get_clients(key):
+                addr = peer.info().grpc_address
+                by_peer.setdefault(addr, (peer, []))[1].append(fwd)
+        for peer, batch in by_peer.values():
+            for lo in range(0, len(batch), self.batch_limit):
+                try:
+                    await asyncio.wait_for(
+                        peer._call_get_peer_rate_limits(
+                            batch[lo:lo + self.batch_limit]
+                        ),
+                        timeout=self.timeout_s,
+                    )
+                    self.region_sends += 1
+                except Exception as e:  # noqa: BLE001
+                    log.error(
+                        "error sending multi-region hits to '%s': %s",
+                        peer.info().grpc_address, e,
+                    )
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Regenerate the protobuf modules (analog of reference scripts/proto.sh).
+#
+# grpc_python_plugin is not available in this image, so only message modules
+# (*_pb2.py) are generated; the service/stub wiring is hand-written in
+# gubernator_tpu/net/grpc_api.py against grpc generic handlers.
+set -euo pipefail
+cd "$(dirname "$0")/../gubernator_tpu/proto"
+protoc --python_out=. -I. gubernator.proto peers.proto
+# protoc emits a flat sibling import; make it package-relative.
+sed -i 's/^import gubernator_pb2 as gubernator__pb2$/from . import gubernator_pb2 as gubernator__pb2/' peers_pb2.py
+echo "regenerated gubernator_pb2.py peers_pb2.py"
