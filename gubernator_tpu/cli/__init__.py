@@ -1,0 +1,11 @@
+"""Command-line entry points (reference cmd/ parity):
+
+- python -m gubernator_tpu.cli.server       — the daemon
+  (cmd/gubernator/main.go)
+- python -m gubernator_tpu.cli.bench_client — load generator
+  (cmd/gubernator-cli/main.go)
+- python -m gubernator_tpu.cli.cluster      — local dev cluster
+  (cmd/gubernator-cluster/main.go)
+- python -m gubernator_tpu.cli.healthcheck  — container health probe
+  (cmd/healthcheck/main.go)
+"""

@@ -1,0 +1,45 @@
+"""The server CLI (reference cmd/gubernator/main.go:40-106).
+
+Reads GUBER_* environment variables (optionally seeded from a --config
+KEY=VALUE file), spawns the daemon, and serves until SIGINT/SIGTERM.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from gubernator_tpu.core.config import setup_daemon_config
+from gubernator_tpu.daemon import Daemon
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="gubernator-tpu daemon")
+    parser.add_argument(
+        "--config", default="", help="KEY=VALUE environment file"
+    )
+    args = parser.parse_args()
+
+    conf = setup_daemon_config(args.config or None)
+    logging.basicConfig(
+        level=getattr(logging, conf.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    async def run() -> None:
+        daemon = Daemon(conf)
+        await daemon.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logging.getLogger("gubernator_tpu").info("shutting down")
+        await daemon.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Client SDK (the analog of reference client.go:31-104 and the generated
+python client, python/gubernator/__init__.py).
+
+Sync and async variants over the same wire stubs; works against any
+wire-compatible daemon (gubernator-tpu or the reference service).
+"""
+from __future__ import annotations
+
+import random
+import string
+import time
+from typing import List, Optional, Sequence
+
+import grpc
+import grpc.aio
+
+from gubernator_tpu.core.types import (
+    HealthCheckResp,
+    RateLimitReq,
+    RateLimitResp,
+)
+from gubernator_tpu.net import grpc_api
+from gubernator_tpu.proto import gubernator_pb2 as pb
+
+# Duration constants in milliseconds (client.go:31-35).
+MILLISECOND = 1
+SECOND = 1000 * MILLISECOND
+MINUTE = 60 * SECOND
+
+
+def hash_key(r: RateLimitReq) -> str:
+    """Canonical cache key (client.go:37-39)."""
+    return r.hash_key()
+
+
+def to_timestamp(ms_from_now: float) -> int:
+    """Unix-ms timestamp `ms_from_now` in the future (client.go:69-74)."""
+    return int(time.time() * 1000) + int(ms_from_now)
+
+
+def from_timestamp(ts_ms: int) -> float:
+    """Milliseconds until `ts_ms` (client.go:77-85)."""
+    return max(0.0, ts_ms - time.time() * 1000)
+
+
+def sleep_until_reset(reset_time_ms: int) -> None:
+    """Block until a rate limit resets (python client helper,
+    python/gubernator/__init__.py:14-21)."""
+    time.sleep(from_timestamp(reset_time_ms) / 1000.0)
+
+
+def random_string(prefix: str = "", n: int = 10) -> str:
+    """Test helper (client.go:88-95)."""
+    return prefix + "".join(
+        random.choices(string.ascii_letters + string.digits, k=n)
+    )
+
+
+class V1Client:
+    """Synchronous client."""
+
+    def __init__(
+        self,
+        address: str = "localhost:1051",
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ) -> None:
+        if credentials is not None:
+            self._channel = grpc.secure_channel(address, credentials)
+        else:
+            self._channel = grpc.insecure_channel(address)
+        self._stub = grpc_api.V1Stub(self._channel)
+
+    def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+    ) -> List[RateLimitResp]:
+        resp = self._stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[grpc_api.req_to_pb(r) for r in reqs]
+            ),
+            timeout=timeout,
+        )
+        return [grpc_api.resp_from_pb(m) for m in resp.responses]
+
+    def health_check(
+        self, timeout: Optional[float] = None
+    ) -> HealthCheckResp:
+        return grpc_api.health_from_pb(
+            self._stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "V1Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class AsyncV1Client:
+    """asyncio client."""
+
+    def __init__(
+        self,
+        address: str = "localhost:1051",
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ) -> None:
+        if credentials is not None:
+            self._channel = grpc.aio.secure_channel(address, credentials)
+        else:
+            self._channel = grpc.aio.insecure_channel(address)
+        self._stub = grpc_api.V1Stub(self._channel)
+
+    async def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+    ) -> List[RateLimitResp]:
+        resp = await self._stub.GetRateLimits(
+            pb.GetRateLimitsReq(
+                requests=[grpc_api.req_to_pb(r) for r in reqs]
+            ),
+            timeout=timeout,
+        )
+        return [grpc_api.resp_from_pb(m) for m in resp.responses]
+
+    async def health_check(
+        self, timeout: Optional[float] = None
+    ) -> HealthCheckResp:
+        return grpc_api.health_from_pb(
+            await self._stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)
+        )
+
+    async def close(self) -> None:
+        await self._channel.close()

@@ -71,8 +71,11 @@ class Config:
     device: DeviceConfig = field(default_factory=DeviceConfig)
     cache_size: int = DEFAULT_CACHE_SIZE
     data_center: str = ""
-    local_picker_hash: str = "fnv1a"  # or "fnv1" (config.go:403-425)
-    region_picker_hash: str = "fnv1a"
+    # "xx" (default; see net/replicated_hash.py on FNV clustering) or
+    # "fnv1"/"fnv1a" for placement interop with reference peers
+    # (config.go:403-425).
+    local_picker_hash: str = "xx"
+    region_picker_hash: str = "xx"
     loader: Optional[object] = None  # runtime.store.Loader
     store: Optional[object] = None  # runtime.store.Store
 
@@ -106,9 +109,17 @@ class DaemonConfig:
 
 @dataclass
 class TLSConfig:
-    """Subset of reference TLSConfig (tls.go:46-138)."""
+    """Subset of reference TLSConfig (tls.go:46-138).
+
+    AutoTLS tiers (tls.go:59-62): with no files at all, a private CA and
+    server cert are generated — single-node only, since each daemon would
+    mint its own CA.  With `ca_file` + `ca_key_file` but no server cert,
+    a per-daemon cert is generated from the SHARED CA — the multi-node
+    AutoTLS mode.
+    """
 
     ca_file: str = ""
+    ca_key_file: str = ""
     cert_file: str = ""
     key_file: str = ""
     client_auth: str = ""  # ""|request|require|verify
@@ -179,6 +190,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     if _env("GUBER_TLS_CERT") or _env("GUBER_TLS_CA"):
         tls = TLSConfig(
             ca_file=_env("GUBER_TLS_CA"),
+            ca_key_file=_env("GUBER_TLS_CA_KEY"),
             cert_file=_env("GUBER_TLS_CERT"),
             key_file=_env("GUBER_TLS_KEY"),
             client_auth=_env("GUBER_TLS_CLIENT_AUTH"),

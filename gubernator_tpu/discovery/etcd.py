@@ -68,12 +68,23 @@ class EtcdPool(Pool):
                 self._keepalive_task, return_exceptions=True
             )
         if self._client is not None:
-            if self._watch_id is not None:
-                self._client.cancel_watch(self._watch_id)
-            key = self.key_prefix + self.self_info.grpc_address
-            self._client.delete(key)
-            if self._lease is not None:
-                self._lease.revoke()
+            loop = asyncio.get_running_loop()
+
+            def teardown() -> None:
+                # Blocking etcd RPCs — keep them off the event loop.
+                if self._watch_id is not None:
+                    self._client.cancel_watch(self._watch_id)
+                key = self.key_prefix + self.self_info.grpc_address
+                self._client.delete(key)
+                if self._lease is not None:
+                    self._lease.revoke()
+
+            try:
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, teardown), timeout=10.0
+                )
+            except (asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
+                log.warning("etcd teardown failed: %s", e)
 
     async def _register(self) -> None:
         """Put our PeerInfo under a leased key (etcd.go:222-260)."""

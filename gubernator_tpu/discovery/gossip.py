@@ -214,12 +214,20 @@ class GossipPool(Pool, asyncio.DatagramProtocol):
                     log.info("gossip: joined %s", addr)
             else:
                 if inc >= cur.incarnation:
-                    if inc > cur.incarnation or not dead:
+                    # Liveness only refreshes on evidence the node itself
+                    # produced: a HIGHER incarnation (it refuted a death).
+                    # Relayed same-incarnation entries must NOT refresh
+                    # last_heard, or a crashed node would be kept alive
+                    # forever by peers echoing each other's stale state —
+                    # direct contact (the `from` sender, below) is the
+                    # only other liveness source (SWIM's direct probe).
+                    if inc > cur.incarnation:
                         cur.last_heard = time.monotonic()
-                    if (cur.dead != dead and inc > cur.incarnation) or (
-                        not dead and cur.dead
-                    ):
-                        cur.dead = dead
+                        if cur.dead and not dead:
+                            cur.dead = False
+                            changed = True
+                    if dead and not cur.dead and inc > cur.incarnation:
+                        cur.dead = True
                         changed = True
                     cur.incarnation = inc
                     cur.info = info

@@ -110,6 +110,11 @@ class PeerClient:
             if has_behavior(req.behavior, Behavior.NO_BATCHING):
                 resps = await self._call_get_peer_rate_limits([req])
                 return resps[0]
+            # Connect BEFORE enqueueing: a failed dial must not leave an
+            # orphaned request for a later batcher to ship after the
+            # caller already saw the failure (peer_client.go:318 connects
+            # first for the same reason).
+            await self._connect()
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
             try:
@@ -118,7 +123,6 @@ class PeerClient:
                 raise PeerNotReadyError(
                     f"peer {self.peer_info.grpc_address} batch queue full"
                 ) from e
-            await self._connect()
             return await fut
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))

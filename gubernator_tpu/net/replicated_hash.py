@@ -22,12 +22,27 @@ import bisect
 import hashlib
 from typing import Callable, Dict, Generic, List, Optional, TypeVar
 
+import xxhash
+
 from gubernator_tpu.core.hashing import fnv1_64, fnv1a_64
 
 DEFAULT_REPLICAS = 512
 
-# Selectable via config `peer_picker_hash` (reference config.go:403-425).
+
+def xx_64(data: bytes) -> int:
+    return xxhash.xxh64_intdigest(data)
+
+
+# Selectable via config `local_picker_hash` / GUBER_PEER_PICKER_HASH
+# (reference config.go:403-425).  "xx" is OUR default: FNV's final byte
+# barely avalanches, so realistic key sets differing only in a trailing
+# id ("account:1", "account:2", ...) hash into a narrow band and can all
+# land in one vnode arc — measured 64 consecutive keys all routing to one
+# of two peers.  The reference defaults to fnv1 and shares the weakness
+# (replicated_hash.go:33); keep fnv1/fnv1a ONLY for placement interop in
+# mixed reference/tpu clusters.
 HASH_FUNCTIONS: Dict[str, Callable[[bytes], int]] = {
+    "xx": xx_64,
     "fnv1": fnv1_64,
     "fnv1a": fnv1a_64,
 }
@@ -53,7 +68,7 @@ class ReplicatedConsistentHash(Generic[P]):
         replicas: int = DEFAULT_REPLICAS,
         key_of: Callable[[P], str] = lambda p: p.info().grpc_address,
     ) -> None:
-        self.hash_fn = hash_fn or fnv1_64
+        self.hash_fn = hash_fn or xx_64
         self.replicas = replicas
         self.key_of = key_of
         self._peers: Dict[str, P] = {}

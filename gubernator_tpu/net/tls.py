@@ -84,8 +84,15 @@ class TLSBundle:
 
 
 def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
-    """Materialize a TLSBundle from config, generating AutoTLS credentials
-    when no cert files are given (SetupTLS, tls.go:140-238)."""
+    """Materialize a TLSBundle from config (SetupTLS, tls.go:140-238).
+
+    Three tiers:
+    1. cert_file + key_file given — load them;
+    2. ca_file + ca_key_file given — generate a per-daemon server cert
+       signed by that SHARED CA (multi-node AutoTLS);
+    3. nothing given — generate a private CA + cert (single-node dev
+       AutoTLS; peers of different daemons would not trust each other).
+    """
     if cfg is None:
         return None
     if cfg.cert_file and cfg.key_file:
@@ -101,7 +108,15 @@ def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
             client_auth=cfg.client_auth,
             insecure_skip_verify=cfg.insecure_skip_verify,
         )
-    ca_pem, ca_key, cert_pem, key_pem = generate_auto_tls()
+    ca_material = None
+    if cfg.ca_file and cfg.ca_key_file:
+        ca_material = (
+            open(cfg.ca_file, "rb").read(),
+            open(cfg.ca_key_file, "rb").read(),
+        )
+    ca_pem, ca_key, cert_pem, key_pem = generate_auto_tls(
+        ca_material=ca_material
+    )
     return TLSBundle(
         ca_pem=ca_pem,
         cert_pem=cert_pem,
@@ -113,9 +128,14 @@ def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
 
 def generate_auto_tls(
     hostnames: Tuple[str, ...] = ("localhost",),
+    ca_material: Optional[Tuple[bytes, bytes]] = None,
 ) -> Tuple[bytes, bytes, bytes, bytes]:
     """Generate (ca_pem, ca_key_pem, server_cert_pem, server_key_pem) for
-    dev/test TLS — the AutoTLS path (tls.go:59-62, 240-329)."""
+    dev/test TLS — the AutoTLS path (tls.go:59-62, 240-329).
+
+    Pass `ca_material=(ca_pem, ca_key_pem)` to sign with an existing CA so
+    multiple daemons share a trust root.
+    """
     import ipaddress
     import socket
 
@@ -128,22 +148,32 @@ def generate_auto_tls(
         return rsa.generate_private_key(public_exponent=65537, key_size=2048)
 
     now = datetime.datetime.now(datetime.timezone.utc)
-    ca_key = make_key()
-    ca_name = x509.Name(
-        [x509.NameAttribute(NameOID.COMMON_NAME, "gubernator-tpu-dev-ca")]
-    )
-    ca_cert = (
-        x509.CertificateBuilder()
-        .subject_name(ca_name)
-        .issuer_name(ca_name)
-        .public_key(ca_key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(minutes=5))
-        .not_valid_after(now + datetime.timedelta(days=365))
-        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
-                       critical=True)
-        .sign(ca_key, hashes.SHA256())
-    )
+    if ca_material is not None:
+        ca_pem_in, ca_key_pem = ca_material
+        ca_cert = x509.load_pem_x509_certificate(ca_pem_in)
+        ca_key = serialization.load_pem_private_key(ca_key_pem, None)
+        ca_name = ca_cert.subject
+    else:
+        ca_key = make_key()
+        ca_name = x509.Name(
+            [x509.NameAttribute(
+                NameOID.COMMON_NAME, "gubernator-tpu-dev-ca"
+            )]
+        )
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(ca_name)
+            .issuer_name(ca_name)
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(
+                x509.BasicConstraints(ca=True, path_length=None),
+                critical=True,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
 
     srv_key = make_key()
     sans = [x509.DNSName(h) for h in hostnames]

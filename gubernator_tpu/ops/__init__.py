@@ -4,7 +4,22 @@ Importing this package enables JAX x64 mode — the protocol's counters and
 timestamps are int64 (proto gubernator.proto:140-161, store.go:29-43) and the
 leaky-bucket remainder is float64.  TPU executes both via XLA's 32-bit-pair
 emulation; the elementwise VPU work here is cheap relative to HBM traffic.
+
+When the process explicitly selects the CPU platform (JAX_PLATFORMS=cpu),
+any registered out-of-process TPU plugin ("axon") is deregistered: with the
+plugin present, the first device->host transfer initializes its client and
+every subsequent dispatch — including pure-CPU ones — pays a ~450us tunnel
+round-trip (60x slowdown, measured with jax 0.9.0).  Deregistering is safe
+here because the env var states CPU-only intent.
 """
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)

@@ -149,24 +149,31 @@ class Service:
     # ------------------------------------------------------------------
     async def set_peers(self, peer_info: Sequence[PeerInfo]) -> None:
         """Atomically swap in a new peer set and drain removed peers
-        (gubernator.go:634-717)."""
-        local = self.local_picker.new()
-        region = self.region_picker.new()
-        for info in peer_info:
-            if info.data_center != self.cfg.data_center:
-                peer = self.region_picker.get_by_address(info.grpc_address)
-                if peer is None:
-                    peer = self._new_peer(info)
-                region.add(peer, info.data_center)
-            else:
-                peer = self.local_picker.get_by_address(info.grpc_address)
-                if peer is None:
-                    peer = self._new_peer(info)
-                else:
-                    peer.peer_info = info  # refresh is_owner flag
-                local.add(peer)
-
+        (gubernator.go:634-717).  The lock spans the whole rebuild so
+        concurrent discovery updates (fire-and-forget on_update tasks)
+        serialize instead of interleaving across awaits; readers run on
+        the same loop and see either the old or the new picker."""
         async with self._peer_lock:
+            local = self.local_picker.new()
+            region = self.region_picker.new()
+            for info in peer_info:
+                if info.data_center != self.cfg.data_center:
+                    peer = self.region_picker.get_by_address(
+                        info.grpc_address
+                    )
+                    if peer is None:
+                        peer = self._new_peer(info)
+                    region.add(peer, info.data_center)
+                else:
+                    peer = self.local_picker.get_by_address(
+                        info.grpc_address
+                    )
+                    if peer is None:
+                        peer = self._new_peer(info)
+                    else:
+                        peer.peer_info = info  # refresh is_owner flag
+                    local.add(peer)
+
             old_local, old_region = self.local_picker, self.region_picker
             self.local_picker, self.region_picker = local, region
 
@@ -275,20 +282,29 @@ class Service:
             for (_, peer, req, key) in forwards
         ]
 
-        if local_idx:
-            local_resps = await self._check_local(
-                [reqs[i] for i in local_idx], local_cached
-            )
-            for j, i in enumerate(local_idx):
-                resp = local_resps[j]
-                if local_owner_meta[j] is not None and not resp.error:
-                    resp.metadata = {"owner": local_owner_meta[j]}
-                responses[i] = resp
-
-        if tasks:
-            results = await asyncio.gather(*tasks)
-            for (i, _, _, _), resp in zip(forwards, results):
-                responses[i] = resp
+        try:
+            if local_idx:
+                local_resps = await self._check_local(
+                    [reqs[i] for i in local_idx], local_cached
+                )
+                for j, i in enumerate(local_idx):
+                    resp = local_resps[j]
+                    if local_owner_meta[j] is not None and not resp.error:
+                        resp.metadata = {"owner": local_owner_meta[j]}
+                    responses[i] = resp
+        finally:
+            # Always await in-flight forwards — a local-check failure must
+            # not orphan tasks whose hits were already applied on peers.
+            if tasks:
+                results = await asyncio.gather(*tasks, return_exceptions=True)
+                for (i, _, _, key), resp in zip(forwards, results):
+                    if isinstance(resp, BaseException):
+                        responses[i] = RateLimitResp(
+                            error=f"Error while fetching rate limit "
+                            f"'{key}' from peer: {resp}"
+                        )
+                    else:
+                        responses[i] = resp
 
         return [r if r is not None else RateLimitResp() for r in responses]
 
@@ -550,9 +566,18 @@ class GlobalManager:
         from dataclasses import replace as dc_replace
 
         globals_: List[UpdatePeerGlobal] = []
+        # Clear GLOBAL (avoid re-queueing a broadcast, global.go:214-215)
+        # AND MULTI_REGION (a zero-hit status read must not wake the
+        # cross-region sender).
         reads = [
             dc_replace(
-                r, hits=0, behavior=Behavior(int(r.behavior) & ~int(Behavior.GLOBAL))
+                r,
+                hits=0,
+                behavior=Behavior(
+                    int(r.behavior)
+                    & ~int(Behavior.GLOBAL)
+                    & ~int(Behavior.MULTI_REGION)
+                ),
             )
             for r in updates.values()
         ]

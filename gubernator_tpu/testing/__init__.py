@@ -1,0 +1,2 @@
+"""Test infrastructure: the in-process multi-daemon cluster fixture."""
+from gubernator_tpu.testing.cluster import Cluster  # noqa: F401

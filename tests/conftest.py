@@ -20,6 +20,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Also DEREGISTER the axon PJRT factory: with the plugin registered, the
+# first device->host transfer anywhere in the process initializes the axon
+# client and every subsequent dispatch pays a ~450us tunnel round-trip —
+# a 60x slowdown of the pure-CPU tests (measured with jax 0.9.0; see
+# gubernator_tpu/ops/__init__.py docstring).
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+
 import pytest  # noqa: E402
 
 from gubernator_tpu.core import clock as clock_mod  # noqa: E402

@@ -1,0 +1,94 @@
+"""Gossip membership tests (the memberlist analog, discovery/gossip.py)."""
+from __future__ import annotations
+
+import asyncio
+
+from gubernator_tpu.core.types import PeerInfo
+from gubernator_tpu.discovery.gossip import GossipPool
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+async def until(cond, timeout=10.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met before timeout")
+        await asyncio.sleep(interval)
+
+
+def _mk_pool(port, seeds, updates, interval=0.1):
+    addr = f"127.0.0.1:{port}"
+    return GossipPool(
+        addr,
+        PeerInfo(grpc_address=f"127.0.0.1:{port - 1000}"),
+        lambda peers: updates.__setitem__(
+            port, [p.grpc_address for p in peers]
+        ),
+        seeds=seeds,
+        gossip_interval_s=interval,
+        suspect_after_s=1.0,
+        reap_after_s=2.0,
+    )
+
+
+def test_join_and_leave():
+    """Three nodes converge on full membership; a leave propagates."""
+    async def scenario():
+        updates = {}
+        ports = [19101, 19102, 19103]
+        seeds = [f"127.0.0.1:{ports[0]}"]
+        pools = [
+            _mk_pool(p, [] if i == 0 else seeds, updates)
+            for i, p in enumerate(ports)
+        ]
+        for p in pools:
+            await p.start()
+        want = sorted(f"127.0.0.1:{p - 1000}" for p in ports)
+        await until(
+            lambda: all(updates.get(p) == want for p in ports)
+        )
+        # Graceful leave propagates.
+        await pools[2].close()
+        want2 = sorted(f"127.0.0.1:{p - 1000}" for p in ports[:2])
+        await until(
+            lambda: all(updates.get(p) == want2 for p in ports[:2])
+        )
+        for p in pools[:2]:
+            await p.close()
+
+    run(scenario())
+
+
+def test_failure_detection():
+    """A silently dead node is suspected and reaped without a leave
+    message — including in a 3-node cluster where the other two keep
+    relaying the dead node's stale entry (the relayed-refresh trap)."""
+    async def scenario():
+        updates = {}
+        ports = [19111, 19112, 19113]
+        pools = [
+            _mk_pool(
+                p, [] if i == 0 else [f"127.0.0.1:{ports[0]}"], updates
+            )
+            for i, p in enumerate(ports)
+        ]
+        for p in pools:
+            await p.start()
+        want = sorted(f"127.0.0.1:{p - 1000}" for p in ports)
+        await until(lambda: all(updates.get(p) == want for p in ports))
+        # Kill node 2 WITHOUT a leave: cancel its loop and close transport
+        # silently.
+        pools[2]._task.cancel()
+        pools[2]._transport.abort()
+        want2 = sorted(f"127.0.0.1:{p - 1000}" for p in ports[:2])
+        await until(
+            lambda: all(updates.get(p) == want2 for p in ports[:2]),
+            timeout=20.0,
+        )
+        for p in pools[:2]:
+            await p.close()
+
+    run(scenario())

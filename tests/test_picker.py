@@ -1,0 +1,118 @@
+"""Consistent-hash picker tests (port of replicated_hash_test.go:28-131)."""
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+from gubernator_tpu.core.hashing import fnv1_64, fnv1a_64
+from gubernator_tpu.net.replicated_hash import (
+    PoolEmptyError,
+    RegionPicker,
+    ReplicatedConsistentHash,
+)
+
+
+class FakePeer:
+    def __init__(self, addr: str, dc: str = "") -> None:
+        self.addr = addr
+        self.dc = dc
+
+    def info(self):
+        class I:  # noqa: N801
+            grpc_address = self.addr
+            data_center = self.dc
+
+        return I()
+
+
+HOSTS = ["a.svc.local", "b.svc.local", "c.svc.local"]
+
+
+def make_picker(hash_fn=None):
+    p = ReplicatedConsistentHash(hash_fn, key_of=lambda peer: peer.addr)
+    for h in HOSTS:
+        p.add(FakePeer(h))
+    return p
+
+
+def test_empty_pool_raises():
+    p = ReplicatedConsistentHash(key_of=lambda peer: peer.addr)
+    with pytest.raises(PoolEmptyError):
+        p.get("key")
+
+
+def test_sequential_keys_spread():
+    """Keys differing only in a trailing id must still spread over peers —
+    the FNV-clustering regression that motivated the xx default."""
+    p = make_picker()  # default hash (xx)
+    counts = Counter(
+        p.get(f"account:{i}").addr for i in range(64)
+    )
+    assert len(counts) == len(HOSTS), f"sequential keys clustered: {counts}"
+
+
+@pytest.mark.parametrize(
+    "hash_fn", [None, fnv1_64, fnv1a_64], ids=["xx", "fnv1", "fnv1a"]
+)
+def test_distribution(hash_fn):
+    """Keys spread over hosts within tolerance
+    (replicated_hash_test.go:60-102 asserts distribution)."""
+    p = make_picker(hash_fn)
+    counts = Counter(p.get(f"key{i}").addr for i in range(30_000))
+    assert set(counts) == set(HOSTS)
+    for host, n in counts.items():
+        assert 0.5 < n / 10_000 < 1.5, f"{host} got {n}"
+
+
+def test_stable_assignment():
+    """Same key -> same host across picker instances and insert orders."""
+    p1 = make_picker()
+    p2 = ReplicatedConsistentHash(key_of=lambda peer: peer.addr)
+    for h in reversed(HOSTS):
+        p2.add(FakePeer(h))
+    for i in range(1000):
+        k = f"stable{i}"
+        assert p1.get(k).addr == p2.get(k).addr
+
+
+def test_minimal_reshuffle_on_join():
+    """Adding a host moves only ~1/N of keys (consistent hashing
+    property)."""
+    p3 = make_picker()
+    p4 = make_picker()
+    p4.add(FakePeer("d.svc.local"))
+    moved = sum(
+        p3.get(f"m{i}").addr != p4.get(f"m{i}").addr for i in range(10_000)
+    )
+    assert moved < 4_000, f"{moved} of 10000 keys moved"
+    # And everything that moved went to the new host.
+    for i in range(2_000):
+        k = f"m{i}"
+        if p3.get(k).addr != p4.get(k).addr:
+            assert p4.get(k).addr == "d.svc.local"
+
+
+def test_region_picker():
+    rp = RegionPicker(
+        ReplicatedConsistentHash(key_of=lambda peer: peer.addr)
+    )
+    for dc in ("us-east", "eu-west"):
+        for i in range(3):
+            rp.add(FakePeer(f"{dc}-{i}:81", dc), dc)
+    owners = rp.get_clients("some_key")
+    assert len(owners) == 2
+    dcs = {o.dc for o in owners}
+    assert dcs == {"us-east", "eu-west"}
+    assert rp.get_by_address("us-east-1:81") is not None
+    assert rp.get_by_address("nope:81") is None
+
+
+def test_fnv_vectors():
+    """fnv1/fnv1a 64-bit against published test vectors."""
+    assert fnv1a_64(b"") == 0xCBF29CE484222325
+    assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a_64(b"foobar") == 0x85944171F73967E8
+    assert fnv1_64(b"") == 0xCBF29CE484222325
+    assert fnv1_64(b"a") == 0xAF63BD4C8601B7BE
+    assert fnv1_64(b"foobar") == 0x340D8765A4DDA9C2

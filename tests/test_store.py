@@ -1,0 +1,111 @@
+"""Store/Loader persistence SPI tests (port of store_test.go:45-200).
+
+TestLoader: Load called once at startup, Save once at shutdown, with
+bucket state preserved.  TestStore: Get seeds misses, OnChange sees every
+state change.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.core.config import Config, DeviceConfig
+from gubernator_tpu.core.types import (
+    Algorithm,
+    CacheItem,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.runtime.service import Service
+from gubernator_tpu.runtime.store import MockLoader, MockStore
+
+DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_loader_load_save_once():
+    """store_test.go:76-125: load at startup, save at shutdown, state
+    round-trips."""
+    async def scenario():
+        loader = MockLoader()
+        svc = Service(Config(device=DEV, loader=loader))
+        await svc.start()
+        r = (await svc.get_rate_limits([
+            RateLimitReq(name="test_loader", unique_key="u", limit=10,
+                         hits=4, duration=60_000)
+        ]))[0]
+        assert r.remaining == 6
+        await svc.close()
+        return loader
+
+    loader = run(scenario())
+    assert loader.called["load"] == 1
+    assert loader.called["save"] == 1
+    live = [i for i in loader.contents if i.key == "test_loader_u"]
+    assert len(live) == 1
+    item = live[0]
+    assert item.algorithm == Algorithm.TOKEN_BUCKET
+    assert item.limit == 10
+    assert item.remaining == 6
+
+    async def scenario2():
+        svc = Service(Config(device=DEV, loader=MockLoader(loader.contents)))
+        await svc.start()
+        r = (await svc.get_rate_limits([
+            RateLimitReq(name="test_loader", unique_key="u", limit=10,
+                         hits=1, duration=60_000)
+        ]))[0]
+        await svc.close()
+        return r
+
+    r = run(scenario2())
+    assert r.remaining == 5, "restored bucket must continue from 6"
+
+
+def test_store_get_and_on_change():
+    """store_test.go:127-200: Get consulted on miss, OnChange after every
+    mutation, for both algorithms."""
+    async def scenario():
+        store = MockStore()
+        # Pre-seed the store with an existing bucket: a miss on device must
+        # restore it rather than create a fresh one.
+        store.data["test_store_seeded"] = CacheItem(
+            key="test_store_seeded",
+            algorithm=Algorithm.TOKEN_BUCKET,
+            expire_at=2**62,  # far future
+            limit=10,
+            duration=60_000,
+            remaining=3,
+            created_at=1,
+            status=Status.UNDER_LIMIT,
+        )
+        svc = Service(Config(device=DEV, store=store))
+        await svc.start()
+        r = (await svc.get_rate_limits([
+            RateLimitReq(name="test_store", unique_key="seeded", limit=10,
+                         hits=1, duration=60_000)
+        ]))[0]
+        assert r.remaining == 2, "must continue from the stored remaining=3"
+
+        # New key: Get misses, OnChange records the new bucket.
+        r = (await svc.get_rate_limits([
+            RateLimitReq(name="test_store", unique_key="fresh", limit=5,
+                         hits=2, duration=60_000,
+                         algorithm=Algorithm.LEAKY_BUCKET)
+        ]))[0]
+        assert r.remaining == 3
+        await svc.close()
+        return store
+
+    store = run(scenario())
+    assert store.called["get"] >= 2
+    assert store.called["on_change"] >= 2
+    fresh = store.data["test_store_fresh"]
+    assert fresh.algorithm == Algorithm.LEAKY_BUCKET
+    assert int(fresh.remaining) == 3
+    seeded = store.data["test_store_seeded"]
+    assert int(seeded.remaining) == 2

@@ -1,0 +1,107 @@
+"""Wire-contract parity tests.
+
+The framework must interoperate with reference clients at the wire level:
+same full method names, same field numbers, same JSON gateway shape
+(reference proto/gubernator.proto, proto/peers.proto).
+"""
+from __future__ import annotations
+
+from gubernator_tpu.core.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    UpdatePeerGlobal,
+)
+from gubernator_tpu.net import grpc_api
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2
+
+
+def test_method_paths():
+    """Full method names match the reference services exactly."""
+    assert grpc_api.V1_SERVICE == "pb.gubernator.V1"
+    assert grpc_api.PEERS_SERVICE == "pb.gubernator.PeersV1"
+    svc = pb.DESCRIPTOR.services_by_name["V1"]
+    assert [m.name for m in svc.methods] == [
+        "GetRateLimits", "HealthCheck",
+    ]
+    psvc = peers_pb2.DESCRIPTOR.services_by_name["PeersV1"]
+    assert [m.name for m in psvc.methods] == [
+        "GetPeerRateLimits", "UpdatePeerGlobals",
+    ]
+
+
+def test_field_numbers_match_reference():
+    """Field tags must match reference gubernator.proto:133-182 for wire
+    compat."""
+    f = pb.RateLimitReq.DESCRIPTOR.fields_by_name
+    want = {
+        "name": 1, "unique_key": 2, "hits": 3, "limit": 4, "duration": 5,
+        "algorithm": 6, "behavior": 7, "burst": 8,
+    }
+    assert {k: v.number for k, v in f.items()} == want
+    f = pb.RateLimitResp.DESCRIPTOR.fields_by_name
+    want = {
+        "status": 1, "limit": 2, "remaining": 3, "reset_time": 4,
+        "error": 5, "metadata": 6,
+    }
+    assert {k: v.number for k, v in f.items()} == want
+    f = peers_pb2.UpdatePeerGlobal.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "key": 1, "status": 2, "algorithm": 3,
+    }
+
+
+def test_enum_values():
+    """Enum numbering matches the reference (gubernator.proto:57-131)."""
+    assert pb.TOKEN_BUCKET == 0 and pb.LEAKY_BUCKET == 1
+    assert pb.BATCHING == 0
+    assert pb.NO_BATCHING == 1
+    assert pb.GLOBAL == 2
+    assert pb.DURATION_IS_GREGORIAN == 4
+    assert pb.RESET_REMAINING == 8
+    assert pb.MULTI_REGION == 16
+    assert pb.UNDER_LIMIT == 0 and pb.OVER_LIMIT == 1
+
+
+def test_roundtrip_codecs():
+    r = RateLimitReq(
+        name="n", unique_key="k", hits=3, limit=100, duration=60_000,
+        algorithm=Algorithm.LEAKY_BUCKET,
+        behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING,
+        burst=50,
+    )
+    r2 = grpc_api.req_from_pb(
+        pb.RateLimitReq.FromString(grpc_api.req_to_pb(r).SerializeToString())
+    )
+    assert r2 == r
+
+    resp = RateLimitResp(
+        status=Status.OVER_LIMIT, limit=10, remaining=0,
+        reset_time=1234567, error="", metadata={"owner": "a:81"},
+    )
+    resp2 = grpc_api.resp_from_pb(
+        pb.RateLimitResp.FromString(
+            grpc_api.resp_to_pb(resp).SerializeToString()
+        )
+    )
+    assert resp2 == resp
+
+    g = UpdatePeerGlobal(key="n_k", status=resp, algorithm=Algorithm.LEAKY_BUCKET)
+    g2 = grpc_api.global_from_pb(
+        peers_pb2.UpdatePeerGlobal.FromString(
+            grpc_api.global_to_pb(g).SerializeToString()
+        )
+    )
+    assert g2.key == g.key and g2.status == g.status
+
+
+def test_negative_int64_on_wire():
+    """Negative hits (token refunds) must survive encoding."""
+    r = RateLimitReq(name="n", unique_key="k", hits=-5, limit=1, duration=1)
+    m = pb.RateLimitReq.FromString(
+        grpc_api.req_to_pb(r).SerializeToString()
+    )
+    assert m.hits == -5
