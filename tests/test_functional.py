@@ -376,15 +376,17 @@ def test_multi_region_hits_propagate(cluster):
     assert r.error == ""
     assert r.remaining == 98
 
+    # Generous window: this runs right after the kill/restart test, so the
+    # region peer may still be reconnecting.
     def check():
         assert d.service.multi_region_mgr.region_sends >= 1
 
-    until_pass(check)
+    until_pass(check, timeout=30.0)
     # The datacenter-1 owner of the key saw the forwarded hits.
     dc1 = [dd for dd in cluster.daemons if dd.conf.data_center]
     def check_remote():
         total = sum(dd.service.backend.checks for dd in dc1)
         assert total >= 1
 
-    until_pass(check_remote)
+    until_pass(check_remote, timeout=30.0)
     cl.close()
