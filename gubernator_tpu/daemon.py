@@ -108,7 +108,17 @@ class Daemon:
         self.conf = conf or DaemonConfig()
         self.clock = clock
         self.metrics = Metrics()
-        self.tls: Optional[TLSBundle] = setup_tls(self.conf.tls)
+        # AutoTLS certs must carry the advertise host in their SANs or
+        # cross-host peer dials fail hostname verification.
+        adv_host = (
+            self.conf.advertise_address.rpartition(":")[0]
+            or resolve_host_ip(self.conf.grpc_listen_address).rpartition(
+                ":"
+            )[0]
+        )
+        self.tls: Optional[TLSBundle] = setup_tls(
+            self.conf.tls, hostnames=("localhost", adv_host)
+        )
         self.service: Optional[Service] = None
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._http_runner: Optional[web.AppRunner] = None
@@ -173,17 +183,20 @@ class Daemon:
         )
 
     async def close(self) -> None:
+        # Order: stop taking traffic (discovery, then listeners with a
+        # drain grace) BEFORE tearing down the service — late requests must
+        # drain, not crash into a closed device executor.
         if self._pool is not None:
             await self._pool.close()
             self._pool = None
-        if self.service is not None:
-            await self.service.close()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=1.0)
             self._grpc_server = None
         if self._http_runner is not None:
             await self._http_runner.cleanup()
             self._http_runner = None
+        if self.service is not None:
+            await self.service.close()
 
     # -- HTTP gateway (daemon.go:231-270) --------------------------------
     async def _start_http(self) -> None:

@@ -28,6 +28,7 @@ class K8sPool(Pool):
         pod_port: int = 81,
         mechanism: str = "endpoints",  # endpoints | pods (WatchMechanism)
         poll_interval_s: float = 5.0,
+        http_port: int = 80,
     ) -> None:
         try:
             import kubernetes  # noqa: F401
@@ -42,11 +43,24 @@ class K8sPool(Pool):
         self.selector = selector
         self.pod_ip = pod_ip
         self.pod_port = pod_port
+        self.http_port = http_port
         self.mechanism = mechanism
         self.poll_interval_s = poll_interval_s
         self._task: Optional[asyncio.Task] = None
+        self._v1 = None
 
     async def start(self) -> None:
+        # Load config + build the API client ONCE (the reference wires the
+        # informer once, kubernetes.go:36-110), not per poll.
+        import kubernetes
+
+        loop = asyncio.get_running_loop()
+
+        def build():
+            kubernetes.config.load_incluster_config()
+            return kubernetes.client.CoreV1Api()
+
+        self._v1 = await loop.run_in_executor(None, build)
         await self._poll_once()
         self._task = asyncio.ensure_future(self._run())
 
@@ -68,10 +82,7 @@ class K8sPool(Pool):
 
     def _list_peers(self) -> Optional[List[PeerInfo]]:
         """List endpoint addresses -> PeerInfo (kubernetes.go:190-244)."""
-        import kubernetes
-
-        kubernetes.config.load_incluster_config()
-        v1 = kubernetes.client.CoreV1Api()
+        v1 = self._v1
         peers: List[PeerInfo] = []
         try:
             if self.mechanism == "pods":
@@ -100,6 +111,7 @@ class K8sPool(Pool):
             peers.append(
                 PeerInfo(
                     grpc_address=f"{ip}:{self.pod_port}",
+                    http_address=f"{ip}:{self.http_port}",
                     is_owner=(ip == self.pod_ip),
                 )
             )

@@ -135,6 +135,25 @@ class PeerClient:
         finally:
             self._track_inflight(-1)
 
+    async def get_peer_rate_limits_batch(
+        self, reqs: List[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """One pre-assembled batch as a single RPC, bypassing the window
+        batcher — the GLOBAL/multi-region flush path (global.go:124-164).
+        Tracked for shutdown drain and the health-check error window."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        self._track_inflight(+1)
+        try:
+            return await self._call_get_peer_rate_limits(reqs)
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
     async def update_peer_globals(
         self, globals_: List[UpdatePeerGlobal]
     ) -> None:

@@ -83,7 +83,10 @@ class TLSBundle:
         return ctx
 
 
-def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
+def setup_tls(
+    cfg: Optional[TLSConfig],
+    hostnames: Tuple[str, ...] = ("localhost",),
+) -> Optional[TLSBundle]:
     """Materialize a TLSBundle from config (SetupTLS, tls.go:140-238).
 
     Three tiers:
@@ -115,7 +118,7 @@ def setup_tls(cfg: Optional[TLSConfig]) -> Optional[TLSBundle]:
             open(cfg.ca_key_file, "rb").read(),
         )
     ca_pem, ca_key, cert_pem, key_pem = generate_auto_tls(
-        ca_material=ca_material
+        hostnames=hostnames, ca_material=ca_material
     )
     return TLSBundle(
         ca_pem=ca_pem,
@@ -176,7 +179,14 @@ def generate_auto_tls(
         )
 
     srv_key = make_key()
-    sans = [x509.DNSName(h) for h in hostnames]
+    # hostnames may mix DNS names and IPs (the daemon passes its advertise
+    # address so cross-host peer dials verify).
+    sans = []
+    for h in hostnames:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
     sans.append(x509.DNSName(socket.gethostname()))
     sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
     srv_cert = (

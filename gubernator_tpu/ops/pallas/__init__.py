@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops.
+
+Each kernel has a pure-XLA semantic reference in gubernator_tpu.ops and is
+differentially tested against it (interpret mode on CPU)."""

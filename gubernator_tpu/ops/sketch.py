@@ -1,0 +1,185 @@
+"""Count-Min-sketch approximate rate limiter — the beyond-exact-state tier.
+
+When the key cardinality outgrows exact per-key slots (the BASELINE.json
+100M-key config), admission control degrades gracefully to a sliding-window
+count-min sketch: O(1) memory per DECISION volume instead of per key, with a
+bounded over-count (never under-count), so it can only over-limit hot tails —
+the safe direction for abuse control.
+
+Design (TPU-first):
+- State is two [D, W] int32 sketches — current and previous window — plus
+  the window index.  Estimated rate = cur + prev * overlap_fraction, the
+  standard sliding-window approximation.
+- The per-batch update/read is expressed as ONE-HOT MATMULS: each key's D
+  bucket columns become one-hot rows; `one_hot.T @ hits` scatters the adds
+  and `one_hot @ sketch[d]` gathers the reads — both ride the MXU instead
+  of fighting serialized HBM scatter.  W is sized to VMEM (<= 32768), which
+  a CMS permits: its error bound e*N/W depends on window DECISION volume N,
+  not key count.
+- Row hashes are derived on device from the key fingerprint with D odd
+  multipliers + shifts (multiply-shift hashing) — no host round trips.
+
+The pure-XLA implementation below is the semantic reference; the fused
+Pallas kernel (ops/pallas/cms_kernel.py) implements the same contract for
+the hot path and is differentially tested against this.
+
+No reference analog: gubernator keeps exact state only and simply evicts
+under pressure (lrucache.go:147-158), silently over-admitting at scale;
+this tier is the TPU build's answer to the same pressure.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 8192
+
+# Odd 64-bit multipliers for multiply-shift row hashing (splitmix64-style
+# constants).
+_ROW_MULTIPLIERS = (
+    0x9E3779B97F4A7C15,
+    0xBF58476D1CE4E5B9,
+    0x94D049BB133111EB,
+    0xD6E8FEB86659FD93,
+    0xA5A3564DDF522B81,
+    0xC2B2AE3D27D4EB4F,
+    0x27D4EB2F165667C5,
+    0x165667B19E3779F9,
+)
+
+
+class SketchState(NamedTuple):
+    """Sliding-window CMS state."""
+
+    cur: jax.Array       # int32[D, W] — counts in the current window
+    prev: jax.Array      # int32[D, W] — counts in the previous window
+    window_start: jax.Array  # int64 scalar — unix ms of window start
+    window_ms: jax.Array     # int64 scalar — window length
+
+
+def init_sketch(
+    depth: int = DEFAULT_DEPTH,
+    width: int = DEFAULT_WIDTH,
+    window_ms: int = 1000,
+) -> SketchState:
+    if depth > len(_ROW_MULTIPLIERS):
+        raise ValueError(f"depth must be <= {len(_ROW_MULTIPLIERS)}")
+    if width & (width - 1):
+        raise ValueError("width must be a power of two")
+    z = lambda: jnp.zeros((depth, width), dtype=jnp.int32)
+    return SketchState(
+        cur=z(),
+        prev=z(),
+        window_start=jnp.int64(0),
+        window_ms=jnp.int64(window_ms),
+    )
+
+
+def row_columns(key_hash: jax.Array, depth: int, width: int) -> jax.Array:
+    """Per-row bucket columns [D, B] from int64 fingerprints [B].
+
+    Multiply-shift: col_d = (h * m_d) >> (64 - log2(W)).
+    """
+    shift = 64 - (width.bit_length() - 1)
+    h = key_hash.astype(jnp.uint64)
+    cols = []
+    for d in range(depth):
+        m = jnp.uint64(_ROW_MULTIPLIERS[d])
+        cols.append(((h * m) >> jnp.uint64(shift)).astype(jnp.int32))
+    return jnp.stack(cols)
+
+
+def _rotate(state: SketchState, now: jax.Array) -> Tuple[SketchState, jax.Array]:
+    """Advance the sliding window.  One step behind -> cur becomes prev;
+    further behind -> both clear.  Returns (state, overlap_weight_f32)."""
+    now = jnp.asarray(now, dtype=jnp.int64)
+    elapsed = now - state.window_start
+    w = state.window_ms
+    in_window = elapsed < w
+    one_behind = (elapsed >= w) & (elapsed < 2 * w)
+    new_start = jnp.where(
+        in_window, state.window_start, now - (elapsed % w)
+    )
+    z = jnp.zeros_like(state.cur)
+    new_prev = jnp.where(in_window, state.prev, jnp.where(one_behind, state.cur, z))
+    new_cur = jnp.where(in_window, state.cur, z)
+    frac = (
+        1.0
+        - (now - new_start).astype(jnp.float32)
+        / w.astype(jnp.float32)
+    )
+    return (
+        SketchState(new_cur, new_prev, new_start, state.window_ms),
+        jnp.clip(frac, 0.0, 1.0),
+    )
+
+
+def cms_step_impl(
+    state: SketchState,
+    key_hash: jax.Array,   # int64[B]; 0 = inactive lane
+    hits: jax.Array,       # int32[B]
+    limit: jax.Array,      # int32[B] — per-lane window limit
+    now: jax.Array,        # int64 scalar ms
+) -> Tuple[SketchState, jax.Array, jax.Array]:
+    """Apply one batch: returns (state', over_limit bool[B], estimate
+    int32[B]).
+
+    Estimate/decide BEFORE adding this batch's hits (like the exact token
+    bucket: a request whose estimate already exceeds limit-hits is over),
+    then scatter the admitted hits.  Duplicate keys in one batch are
+    handled naturally — the one-hot matmul sums them into the same column;
+    their lanes share one pre-batch estimate (a one-batch-granularity
+    approximation consistent with CMS semantics).
+
+    Over-limited hits are still counted (abusers stay counted, matching
+    CMS-limiter practice — and unlike the exact bucket, which ignores
+    over-limit hits).
+    """
+    depth, width = state.cur.shape
+    state, overlap = _rotate(state, now)
+    active = key_hash != 0
+    cols = row_columns(key_hash, depth, width)           # [D, B]
+
+    onehots = jax.nn.one_hot(cols, width, dtype=jnp.float32)  # [D, B, W]
+    onehots = onehots * active[None, :, None]
+
+    # Gather reads: est_d = onehot[d] @ (cur + prev*overlap) — MXU.
+    eff = (
+        state.cur.astype(jnp.float32)
+        + state.prev.astype(jnp.float32) * overlap
+    )                                                     # [D, W]
+    reads = jnp.einsum("dbw,dw->db", onehots, eff)        # [D, B]
+    estimate = jnp.min(reads, axis=0)                     # [B]
+
+    over = active & (
+        estimate + hits.astype(jnp.float32)
+        > limit.astype(jnp.float32)
+    ) & (hits > 0)
+
+    # Scatter adds: upd_d = onehot[d].T @ hits — MXU.
+    upd = jnp.einsum(
+        "dbw,b->dw", onehots, hits.astype(jnp.float32)
+    )                                                     # [D, W]
+    new_cur = state.cur + upd.astype(jnp.int32)
+
+    return (
+        SketchState(new_cur, state.prev, state.window_start, state.window_ms),
+        over,
+        estimate.astype(jnp.int32),
+    )
+
+
+cms_step = jax.jit(cms_step_impl, donate_argnums=(0,))
+
+
+def make_cms_step(use_pallas: bool = False):
+    """Step factory: the XLA path or the fused Pallas kernel."""
+    if not use_pallas:
+        return cms_step
+    from gubernator_tpu.ops.pallas.cms_kernel import cms_step_pallas
+
+    return cms_step_pallas

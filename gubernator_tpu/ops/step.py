@@ -532,3 +532,8 @@ def store_cached_rows_impl(
         expire_at=scat(table.expire_at, rows.reset_time),
         touched=scat(table.touched, jnp.full_like(rows.key_hash, now)),
     )
+
+
+store_cached_rows = jax.jit(
+    store_cached_rows_impl, static_argnames=("ways",), donate_argnums=(0,)
+)

@@ -40,7 +40,7 @@ from gubernator_tpu.ops.step import (
     apply_batch,
     load_rows,
     probe_batch,
-    store_cached_rows_impl,
+    store_cached_rows,
 )
 
 
@@ -68,9 +68,12 @@ class DeviceBackend:
         self._step = functools.partial(apply_batch, ways=self.cfg.ways)
         self._load_rows = functools.partial(load_rows, ways=self.cfg.ways)
         self._probe = functools.partial(probe_batch, ways=self.cfg.ways)
-        self._store_cached = jax.jit(
-            functools.partial(store_cached_rows_impl, ways=self.cfg.ways),
-            donate_argnums=(0,),
+        # Module-level jits (apply_batch/load_rows/probe_batch/
+        # store_cached_rows) share one compile cache across backends — the
+        # in-process cluster fixture runs many daemons per process and
+        # per-instance jits would recompile per daemon.
+        self._store_cached = functools.partial(
+            store_cached_rows, ways=self.cfg.ways
         )
         self.store = store
         # fingerprint -> hash-key string, maintained when persistence needs
@@ -163,6 +166,44 @@ class DeviceBackend:
             found, _ = self._probe(self.table, padded, np.int64(now))
             out[lo:lo + len(chunk)] = np.asarray(found)[: len(chunk)]
         return out
+
+    def warmup(self) -> None:
+        """Compile the hot-path executables with a synthetic batch that
+        bypasses the Store/Loader hooks and the keymap — no persistence
+        side effects (a real check() would leak the synthetic key into an
+        attached store)."""
+        now = np.int64(self.clock.millisecond_now())
+        packed = pack_requests(
+            [RateLimitReq(name="__warmup__", unique_key="w", hits=0,
+                          limit=1, duration=1)],
+            self.cfg.batch_size,
+            self.clock,
+        )
+        with self._lock:
+            for db in packed.rounds:
+                self.table, resp = self._step(self.table, _to_device(db), now)
+            # Fixed-shape probe executable (store seeding / bulk reads).
+            self._probe(
+                self.table,
+                np.zeros(self.cfg.batch_size, dtype=np.int64),
+                now,
+            )
+            # Broadcast-receive executable (UpdatePeerGlobals path) — a
+            # first compile inside a peer's RPC deadline would time out.
+            B = self.cfg.batch_size
+            self.table = self._store_cached(
+                self.table,
+                CachedRows(
+                    key_hash=np.zeros(B, dtype=np.int64),
+                    algo=np.zeros(B, dtype=np.int32),
+                    limit=np.zeros(B, dtype=np.int64),
+                    remaining=np.zeros(B, dtype=np.int64),
+                    status=np.zeros(B, dtype=np.int32),
+                    reset_time=np.zeros(B, dtype=np.int64),
+                ),
+                now,
+            )
+        jax.block_until_ready(resp)
 
     def _maybe_prune_keymap(self) -> None:
         """Bound the fingerprint->key map: the table holds at most num_slots

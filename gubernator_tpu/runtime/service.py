@@ -134,15 +134,7 @@ class Service:
         # Warm the jitted device step so the first client request doesn't
         # pay XLA compilation (20-40s cold) inside an RPC deadline.
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
-            self._dev_executor,
-            lambda: self.backend.check(
-                [RateLimitReq(
-                    name="__warmup__", unique_key="w", hits=0, limit=1,
-                    duration=1,
-                )]
-            ),
-        )
+        await loop.run_in_executor(self._dev_executor, self.backend.warmup)
 
     # ------------------------------------------------------------------
     # peer management
@@ -530,15 +522,15 @@ class GlobalManager:
             addr = peer.info().grpc_address
             by_peer.setdefault(addr, (peer, []))[1].append(r)
         start = time.monotonic()
-        for peer, batch in by_peer.values():
-            # One RPC per batch_limit-sized slice (the owner rejects batches
-            # over MAX_BATCH_SIZE, gubernator.go:486-490).
+
+        async def flush_one(peer: PeerClient, batch: List[RateLimitReq]):
+            # One RPC per batch_limit-sized slice (the owner rejects
+            # batches over MAX_BATCH_SIZE, gubernator.go:486-490).
             for lo in range(0, len(batch), self.batch_limit):
+                chunk = batch[lo:lo + self.batch_limit]
                 try:
                     await asyncio.wait_for(
-                        peer._call_get_peer_rate_limits(
-                            batch[lo:lo + self.batch_limit]
-                        ),
+                        peer.get_peer_rate_limits_batch(chunk),
                         timeout=self.timeout_s,
                     )
                     self.async_sends += 1
@@ -547,6 +539,17 @@ class GlobalManager:
                         "error sending global hits to '%s': %s",
                         peer.info().grpc_address, e,
                     )
+                    # Re-queue so a transiently unreachable owner doesn't
+                    # lose the window's hits (improvement over the
+                    # reference, which drops them — global.go:152-162);
+                    # aggregation bounds the backlog by unique keys.
+                    for r in chunk:
+                        self.queue_hit(r)
+
+        # Fan out per peer — one slow peer must not delay the others.
+        await asyncio.gather(
+            *(flush_one(p, b) for p, b in by_peer.values())
+        )
         self.s.metrics.async_durations.observe(time.monotonic() - start)
 
     async def _run_broadcasts(self) -> None:
@@ -597,10 +600,8 @@ class GlobalManager:
         if not globals_:
             return
         start = time.monotonic()
-        sent = False
-        for peer in self.s.peer_list():
-            if peer.info().is_owner:
-                continue
+
+        async def push_one(peer: PeerClient) -> bool:
             try:
                 # Chunk to respect the receiver's 1MB message cap.
                 for lo in range(0, len(globals_), self.batch_limit):
@@ -610,14 +611,24 @@ class GlobalManager:
                         ),
                         timeout=self.timeout_s,
                     )
-                sent = True
+                return True
             except PeerNotReadyError:
-                continue
+                return False
             except Exception as e:  # noqa: BLE001
                 log.error(
                     "while broadcasting global updates to '%s': %s",
                     peer.info().grpc_address, e,
                 )
+                return False
+
+        results = await asyncio.gather(
+            *(
+                push_one(p)
+                for p in self.s.peer_list()
+                if not p.info().is_owner
+            )
+        )
+        sent = any(results)
         if sent:
             self.broadcasts += 1
             self.s.metrics.broadcast_durations.observe(
@@ -691,21 +702,34 @@ class MultiRegionManager:
             for peer in self.s.region_picker.get_clients(key):
                 addr = peer.info().grpc_address
                 by_peer.setdefault(addr, (peer, []))[1].append(fwd)
-        for peer, batch in by_peer.values():
+        async def flush_one(peer: PeerClient, batch: List[RateLimitReq]):
             for lo in range(0, len(batch), self.batch_limit):
-                try:
-                    await asyncio.wait_for(
-                        peer._call_get_peer_rate_limits(
-                            batch[lo:lo + self.batch_limit]
-                        ),
-                        timeout=self.timeout_s,
-                    )
-                    self.region_sends += 1
-                except Exception as e:  # noqa: BLE001
-                    log.error(
-                        "error sending multi-region hits to '%s': %s",
-                        peer.info().grpc_address, e,
-                    )
+                chunk = batch[lo:lo + self.batch_limit]
+                attempts = 0
+                while True:
+                    try:
+                        await asyncio.wait_for(
+                            peer.get_peer_rate_limits_batch(chunk),
+                            timeout=self.timeout_s,
+                        )
+                        self.region_sends += 1
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        # Retry in place (with the peer that failed): a
+                        # GLOBAL-style re-queue would double-count the
+                        # regions that already received this window's fan.
+                        attempts += 1
+                        if attempts > 3:
+                            log.error(
+                                "dropping multi-region hits for '%s': %s",
+                                peer.info().grpc_address, e,
+                            )
+                            break
+                        await asyncio.sleep(self.sync_wait_s)
+
+        await asyncio.gather(
+            *(flush_one(p, b) for p, b in by_peer.values())
+        )
 
     async def close(self) -> None:
         if self._task is not None:
