@@ -1,0 +1,151 @@
+"""ctypes bindings for the C++ host runtime (native/gubtpu.cpp).
+
+Loads `libgubtpu.so` from this directory, building it with `make -C native`
+on first use when a toolchain is present.  All entry points have pure-Python
+fallbacks (core/hashing.py, ops/batch.py), so the library is an
+accelerator, not a dependency; `available()` reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("gubernator_tpu.native")
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libgubtpu.so")
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), os.pardir, os.pardir, "native"
+)
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_load_lock = threading.Lock()
+
+
+def _build() -> bool:
+    """Compile via make; the Makefile writes to a temp path and renames so
+    concurrent builders (other processes) never expose a half-written .so."""
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.info("native build unavailable (%s); using python paths", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _load_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        lib = _try_load()
+        if lib is None:
+            # Missing, stale-arch, or torn artifact: rebuild once and retry.
+            if _build():
+                lib = _try_load()
+        if lib is None:
+            return None
+        lib = _bind(lib)
+        _lib = lib
+        return _lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        return ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        log.warning("failed to load %s: %s", _SO_PATH, e)
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.gub_xxh64_batch.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.gub_xxh64_batch.restype = None
+    lib.gub_assign_rounds.argtypes = [
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p,  # shards (int32*) or None
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+    lib.gub_assign_rounds.restype = ctypes.c_int64
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def hash_keys(keys) -> np.ndarray:
+    """XXH64 fingerprints (int64, 0 remapped to 1) of a list of strings."""
+    lib = _load()
+    n = len(keys)
+    if lib is None:
+        from gubernator_tpu.core.hashing import bulk_key_hash64
+
+        return bulk_key_hash64(keys)
+    encoded = [k.encode() for k in keys]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    blob = b"".join(encoded)
+    out = np.empty(n, dtype=np.int64)
+    lib.gub_xxh64_batch(blob, offsets, n, out)
+    return out
+
+
+def assign_rounds(
+    hashes: np.ndarray,
+    shards: Optional[np.ndarray],
+    n_shards: int,
+    batch_size: int,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(round, lane) per request + round count; hashes==0 lanes skipped.
+
+    Native only — callers fall back to the ops/batch.py python loop when
+    `available()` is False.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(hashes)
+    out_round = np.empty(n, dtype=np.int32)
+    out_lane = np.empty(n, dtype=np.int32)
+    shard_ptr = (
+        shards.ctypes.data_as(ctypes.c_void_p)
+        if shards is not None
+        else None
+    )
+    n_rounds = lib.gub_assign_rounds(
+        np.ascontiguousarray(hashes, dtype=np.int64),
+        shard_ptr,
+        n,
+        n_shards,
+        batch_size,
+        out_round,
+        out_lane,
+    )
+    return out_round, out_lane, int(n_rounds)

@@ -92,7 +92,33 @@ def pack_requests_grid(
     contract), and occurrence k of a key lands in a strictly later round than
     occurrence k-1 (so same-key requests observe each other's effects in
     order, like the reference's per-key worker serialization).
+
+    Dispatches to the C++ fast path (native/gubtpu.cpp: batched XXH64 +
+    round assignment, with numpy-scatter lane fill) when the native library
+    is loadable; this python loop is the semantic reference and fallback.
+    The native path detects duplicates by 64-bit fingerprint rather than key
+    string — safe, because fingerprint-colliding keys share a device slot
+    and MUST be round-separated anyway.
     """
+    from gubernator_tpu import native
+
+    if native.available():
+        return _pack_requests_grid_native(
+            reqs, batch_size, n_shards, shard_fn, clock, use_cached
+        )
+    return _pack_requests_grid_py(
+        reqs, batch_size, n_shards, shard_fn, clock, use_cached
+    )
+
+
+def _pack_requests_grid_py(
+    reqs: Sequence[RateLimitReq],
+    batch_size: int,
+    n_shards: int,
+    shard_fn,
+    clock: Optional[clock_mod.Clock] = None,
+    use_cached: Optional[Sequence[bool]] = None,
+) -> PackedGrid:
     clock = clock or clock_mod.default_clock()
     now_dt = clock.now()
 
@@ -112,6 +138,15 @@ def pack_requests_grid(
         if not r.name:
             errors[i] = "field 'namespace' cannot be empty"
             continue
+        # Pre-validate Gregorian intervals so an invalid request never
+        # claims a round/lane (it would leave phantom all-inactive rounds
+        # and shift later requests' positions).
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            try:
+                gregorian_expiration(now_dt, r.duration)
+            except GregorianError as e:
+                errors[i] = str(e)
+                continue
         key = r.hash_key()
         shard = shard_cache.get(key)
         if shard is None:
@@ -158,6 +193,115 @@ def pack_requests_grid(
     return PackedGrid(rounds=rounds, positions=positions, errors=errors)
 
 
+def _pack_requests_grid_native(
+    reqs: Sequence[RateLimitReq],
+    batch_size: int,
+    n_shards: int,
+    shard_fn,
+    clock: Optional[clock_mod.Clock] = None,
+    use_cached: Optional[Sequence[bool]] = None,
+) -> PackedGrid:
+    """C++-assisted packing: batched key hashing and round assignment in
+    native code, lane fill as numpy scatters.  Same contract as the python
+    reference (differential-tested in tests/test_native.py)."""
+    from gubernator_tpu import native
+
+    clock = clock or clock_mod.default_clock()
+    now_dt = clock.now()
+    n = len(reqs)
+    errors: Dict[int, str] = {}
+
+    keys: List[str] = [""] * n
+    shard_arr = np.zeros(n, dtype=np.int32) if n_shards > 1 else None
+    # Validation + per-request scalars (one python pass; everything
+    # downstream is vectorized).
+    hits = np.zeros(n, dtype=np.int64)
+    limit = np.zeros(n, dtype=np.int64)
+    duration = np.zeros(n, dtype=np.int64)
+    algo = np.zeros(n, dtype=np.int32)
+    burst = np.zeros(n, dtype=np.int64)
+    reset = np.zeros(n, dtype=bool)
+    is_greg = np.zeros(n, dtype=bool)
+    greg_expire = np.zeros(n, dtype=np.int64)
+    greg_duration = np.zeros(n, dtype=np.int64)
+    cached = np.zeros(n, dtype=bool)
+
+    shard_cache: Dict[str, int] = {}
+    for i, r in enumerate(reqs):
+        if not r.unique_key:
+            errors[i] = "field 'unique_key' cannot be empty"
+            continue
+        if not r.name:
+            errors[i] = "field 'namespace' cannot be empty"
+            continue
+        b = int(r.behavior)
+        if b & int(Behavior.DURATION_IS_GREGORIAN):
+            try:
+                greg_expire[i] = gregorian_expiration(now_dt, r.duration)
+                greg_duration[i] = gregorian_duration(now_dt, r.duration)
+            except GregorianError as e:
+                errors[i] = str(e)
+                continue
+            is_greg[i] = True
+        key = r.hash_key()
+        keys[i] = key
+        if shard_arr is not None:
+            s = shard_cache.get(key)
+            if s is None:
+                s = shard_fn(key)
+                shard_cache[key] = s
+            shard_arr[i] = s
+        hits[i] = r.hits
+        limit[i] = r.limit
+        duration[i] = r.duration
+        algo[i] = int(r.algorithm)
+        burst[i] = r.burst if r.burst != 0 else r.limit
+        reset[i] = bool(b & int(Behavior.RESET_REMAINING))
+        if use_cached is not None:
+            cached[i] = bool(use_cached[i])
+
+    hashes = native.hash_keys(keys)
+    for i in errors:
+        hashes[i] = 0
+    rnd, lane, n_rounds = native.assign_rounds(
+        hashes, shard_arr, n_shards, batch_size
+    )
+
+    positions: List[Tuple[int, int, int]] = [
+        (
+            (int(rnd[i]), int(shard_arr[i]) if shard_arr is not None else 0,
+             int(lane[i]))
+            if rnd[i] >= 0
+            else (-1, -1, -1)
+        )
+        for i in range(n)
+    ]
+
+    sh = shard_arr if shard_arr is not None else np.zeros(n, dtype=np.int32)
+    # Group requests by round with ONE stable sort (O(n log n)), not a full
+    # mask scan per round — duplicate-heavy batches make n_rounds ~ n.
+    ok_idx = np.flatnonzero(rnd >= 0)
+    order = ok_idx[np.argsort(rnd[ok_idx], kind="stable")]
+    bounds = np.searchsorted(rnd[order], np.arange(n_rounds + 1))
+    values = dict(
+        key_hash=hashes, hits=hits, limit=limit, duration=duration,
+        algo=algo, burst=burst, reset_remaining=reset, is_greg=is_greg,
+        greg_expire=greg_expire, greg_duration=greg_duration,
+        use_cached=cached,
+    )
+    rounds: List[DeviceBatch] = []
+    for r_idx in range(n_rounds):
+        batch = _empty_batch((n_shards, batch_size))
+        sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+        s_m, l_m = sh[sel], lane[sel]
+        for f, v in values.items():
+            getattr(batch, f)[s_m, l_m] = v[sel]
+        batch.active[s_m, l_m] = True
+        rounds.append(batch)
+
+    return PackedGrid(rounds=rounds, positions=positions, errors=errors)
+
+
 def pack_requests(
     reqs: Sequence[RateLimitReq],
     batch_size: int,
@@ -178,21 +322,26 @@ def pack_requests(
     )
 
 
-def _empty_batch(batch_size: int) -> DeviceBatch:
-    z64 = lambda: np.zeros(batch_size, dtype=np.int64)
+_BATCH_DTYPES = dict(
+    key_hash=np.int64,
+    hits=np.int64,
+    limit=np.int64,
+    duration=np.int64,
+    algo=np.int32,
+    burst=np.int64,
+    reset_remaining=bool,
+    is_greg=bool,
+    greg_expire=np.int64,
+    greg_duration=np.int64,
+    active=bool,
+    use_cached=bool,
+)
+
+
+def _empty_batch(shape) -> DeviceBatch:
+    """All-inactive batch of the given shape (int or tuple)."""
     return DeviceBatch(
-        key_hash=z64(),
-        hits=z64(),
-        limit=z64(),
-        duration=z64(),
-        algo=np.zeros(batch_size, dtype=np.int32),
-        burst=z64(),
-        reset_remaining=np.zeros(batch_size, dtype=bool),
-        is_greg=np.zeros(batch_size, dtype=bool),
-        greg_expire=z64(),
-        greg_duration=z64(),
-        active=np.zeros(batch_size, dtype=bool),
-        use_cached=np.zeros(batch_size, dtype=bool),
+        **{f: np.zeros(shape, dtype=dt) for f, dt in _BATCH_DTYPES.items()}
     )
 
 

@@ -1,0 +1,193 @@
+// gubernator-tpu native host runtime.
+//
+// The device step is sub-millisecond; at batch_limit-scale traffic the
+// host-side request packing (per-key string hashing + duplicate-round
+// assignment) dominates when done in Python.  This library provides the two
+// hot host ops over raw buffers, exposed via a C ABI for ctypes
+// (gubernator_tpu/native/__init__.py):
+//
+//   gub_xxh64_batch    — XXH64 of N length-prefixed keys (the device
+//                        fingerprint; matches python-xxhash seed 0)
+//   gub_assign_rounds  — the packer's (round, lane) assignment with
+//                        per-(round, shard) lane counters and hash-level
+//                        duplicate detection (ops/batch.py's contract:
+//                        occurrence k of a key lands in a strictly later
+//                        round than occurrence k-1)
+//
+// Build: make -C native  (g++ -O3 -shared; no external dependencies —
+// XXH64 is implemented from its public spec below).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// XXH64 (from the xxHash spec; seed fixed to 0 like core/hashing.py)
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/arm64)
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint64_t xxh64_round(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  acc *= P1;
+  return acc;
+}
+
+static inline uint64_t xxh64_merge(uint64_t acc, uint64_t val) {
+  val = xxh64_round(0, val);
+  acc ^= val;
+  acc = acc * P1 + P4;
+  return acc;
+}
+
+static uint64_t xxh64(const uint8_t* p, size_t len) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = P1 + P2, v2 = P2, v3 = 0, v4 = 0 - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xxh64_round(v1, read64(p));
+      v2 = xxh64_round(v2, read64(p + 8));
+      v3 = xxh64_round(v3, read64(p + 16));
+      v4 = xxh64_round(v4, read64(p + 24));
+      p += 32;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = xxh64_merge(h, v1);
+    h = xxh64_merge(h, v2);
+    h = xxh64_merge(h, v3);
+    h = xxh64_merge(h, v4);
+  } else {
+    h = P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xxh64_round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// Hash n keys packed as a concatenated blob with (n+1) byte offsets.
+// out[i] = xxh64(blob[offsets[i]:offsets[i+1]]), remapped 0 -> 1 (the
+// empty-slot sentinel rule, core/hashing.py key_hash64).
+void gub_xxh64_batch(const uint8_t* blob, const int64_t* offsets, int64_t n,
+                     int64_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h =
+        xxh64(blob + offsets[i], (size_t)(offsets[i + 1] - offsets[i]));
+    if (h == 0) h = 1;
+    out[i] = (int64_t)h;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Round/lane assignment (ops/batch.py pack_requests_grid inner loop)
+// ---------------------------------------------------------------------------
+
+// Open-addressing map from key hash -> last assigned round (linear probe).
+struct RoundMap {
+  std::vector<uint64_t> keys;
+  std::vector<int32_t> last_round;
+  uint64_t mask;
+  explicit RoundMap(int64_t n) {
+    uint64_t cap = 16;
+    while (cap < (uint64_t)n * 2) cap <<= 1;
+    keys.assign(cap, 0);
+    last_round.assign(cap, -1);
+    mask = cap - 1;
+  }
+  int32_t* slot(uint64_t h) {
+    uint64_t i = (h * P1) & mask;
+    while (keys[i] != 0 && keys[i] != h) i = (i + 1) & mask;
+    keys[i] = h;
+    return &last_round[i];
+  }
+};
+
+// Assign each request a (round, lane) such that:
+//  - a key hash appears at most once per round,
+//  - occurrence k of a key lands in a strictly later round than k-1,
+//  - each (round, shard) holds at most batch_size lanes.
+// hashes[i] == 0 marks an errored request (skipped; round=-1).
+// Returns the number of rounds.
+int64_t gub_assign_rounds(const int64_t* hashes, const int32_t* shards,
+                          int64_t n, int32_t n_shards, int32_t batch_size,
+                          int32_t* out_round, int32_t* out_lane) {
+  RoundMap seen(n);
+  // counters[r * n_shards + s] = lanes used; keysets per round for the
+  // "key not in round" check are implied by last_round tracking: a key's
+  // next occurrence starts probing at last_round+1, and WITHIN one probe
+  // sequence only capacity can force extra rounds, never the same key.
+  std::vector<int32_t> counters;
+  int64_t n_rounds = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = (uint64_t)hashes[i];
+    if (h == 0) {
+      out_round[i] = -1;
+      out_lane[i] = -1;
+      continue;
+    }
+    int32_t s = shards ? shards[i] : 0;
+    int32_t* lr = seen.slot(h);
+    int32_t r = *lr + 1;
+    for (;;) {
+      if (r >= n_rounds) {
+        counters.resize((size_t)(r + 1) * n_shards, 0);
+        n_rounds = r + 1;
+      }
+      int32_t& c = counters[(size_t)r * n_shards + s];
+      if (c < batch_size) {
+        out_round[i] = r;
+        out_lane[i] = c;
+        c++;
+        *lr = r;
+        break;
+      }
+      r++;
+    }
+  }
+  return n_rounds;
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Native host runtime tests: XXH64 parity + packer differential."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+import xxhash
+
+from gubernator_tpu import native
+from gubernator_tpu.core.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.ops.batch import (
+    _pack_requests_grid_native,
+    _pack_requests_grid_py,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+
+def test_xxh64_parity():
+    rng = random.Random(0)
+    keys = [
+        "".join(
+            rng.choices("abcdefghijklmnop_0123456789:", k=rng.randint(0, 200))
+        )
+        for _ in range(2000)
+    ]
+    got = native.hash_keys(keys)
+    want = np.array(
+        [xxhash.xxh64_intdigest(k) or 1 for k in keys], dtype=np.uint64
+    ).view(np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+def _random_reqs(rng, n):
+    reqs = []
+    for i in range(n):
+        bad = rng.random() < 0.05
+        behavior = Behavior.BATCHING
+        duration = rng.randint(1000, 60_000)
+        p = rng.random()
+        if p < 0.1:
+            behavior = Behavior.RESET_REMAINING
+        elif p < 0.2:
+            # Gregorian lanes, including invalid interval ids (errors must
+            # not claim rounds/lanes in either packer).
+            behavior = Behavior.DURATION_IS_GREGORIAN
+            duration = rng.choice([0, 1, 2, 4, 99])
+        reqs.append(
+            RateLimitReq(
+                name="" if bad else f"n{rng.randint(0, 5)}",
+                unique_key=f"k{rng.randint(0, n // 2)}",
+                hits=rng.randint(0, 5),
+                limit=rng.randint(1, 100),
+                duration=duration,
+                algorithm=rng.choice(list(Algorithm)),
+                behavior=behavior,
+                burst=rng.choice([0, 50]),
+            )
+        )
+    return reqs
+
+
+@pytest.mark.parametrize("n_shards", [1, 4])
+def test_packer_differential(n_shards):
+    """Native and python packers must produce identical grids."""
+    rng = random.Random(42)
+    reqs = _random_reqs(rng, 500)
+
+    def shard_fn(key: str) -> int:
+        return hash(key) % n_shards
+
+    a = _pack_requests_grid_native(reqs, 64, n_shards, shard_fn)
+    b = _pack_requests_grid_py(reqs, 64, n_shards, shard_fn)
+    assert a.errors == b.errors
+    assert a.positions == b.positions
+    assert len(a.rounds) == len(b.rounds)
+    for ra, rb in zip(a.rounds, b.rounds):
+        for f in ra._fields:
+            np.testing.assert_array_equal(
+                getattr(ra, f), getattr(rb, f), err_msg=f
+            )
+
+
+def test_packer_duplicate_rounds():
+    """Same key N times -> N sequential rounds, native path."""
+    reqs = [
+        RateLimitReq(name="d", unique_key="x", hits=1, limit=10,
+                     duration=1000)
+        for _ in range(5)
+    ]
+    g = _pack_requests_grid_native(reqs, 16, 1, lambda k: 0)
+    assert [p[0] for p in g.positions] == [0, 1, 2, 3, 4]
+    assert len(g.rounds) == 5
