@@ -26,6 +26,11 @@ def main() -> None:
         level=getattr(logging, conf.log_level.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # OTel tracing from standard OTEL_* env vars (cmd/gubernator/main.go
+    # initializes its tracer the same way, main.go:56-69).
+    from gubernator_tpu.runtime.tracing import init_tracing
+
+    init_tracing()
 
     async def run() -> None:
         daemon = Daemon(conf)

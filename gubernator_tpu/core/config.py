@@ -64,6 +64,20 @@ class DeviceConfig:
 
 
 @dataclass
+class SketchTierConfig:
+    """Approximate (count-min sketch) tier: limit names whose key
+    cardinality outgrows exact slots (no reference analog — the reference
+    silently over-admits under cache pressure, lrucache.go:147-158)."""
+
+    names: List[str] = field(default_factory=list)
+    depth: int = 4
+    width: int = 8192  # power of two; error ~ window volume / width
+    window_ms: int = 1000
+    batch_size: int = 1024
+    use_pallas: bool = False  # fused TPU kernel (ops/pallas/cms_kernel.py)
+
+
+@dataclass
 class Config:
     """Service-instance config (reference config.go:44-113)."""
 
@@ -78,6 +92,7 @@ class Config:
     region_picker_hash: str = "xx"
     loader: Optional[object] = None  # runtime.store.Loader
     store: Optional[object] = None  # runtime.store.Store
+    sketch: Optional[SketchTierConfig] = None  # approximate tier
 
 
 @dataclass

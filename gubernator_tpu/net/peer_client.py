@@ -51,8 +51,10 @@ class PeerClient:
         info: PeerInfo,
         behavior: Optional[BehaviorConfig] = None,
         channel_credentials: Optional[grpc.ChannelCredentials] = None,
+        metrics=None,
     ) -> None:
         self.peer_info = info
+        self.metrics = metrics
         self.behavior = behavior or BehaviorConfig()
         self._creds = channel_credentials
         self._channel: Optional[grpc.aio.Channel] = None
@@ -247,8 +249,17 @@ class PeerClient:
         """One RPC for the whole batch; responses map back by position
         (peer_client.go:450-509)."""
         reqs = [r for r, _ in batch]
+        start = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.queue_length.labels(
+                peerAddr=self.peer_info.grpc_address
+            ).observe(len(batch))
         try:
             resps = await self._call_get_peer_rate_limits(reqs)
+            if self.metrics is not None:
+                self.metrics.batch_send_duration.labels(
+                    peerAddr=self.peer_info.grpc_address
+                ).observe(time.monotonic() - start)
             if len(resps) != len(batch):
                 raise PeerNotReadyError(
                     "peer returned %d responses for %d requests"

@@ -173,14 +173,10 @@ def _pack_requests_grid_py(
         for shard, entries in enumerate(shards):
             for lane, (i, r) in enumerate(entries):
                 positions[i] = (rnd_idx, shard, lane)
-                err = _fill_lane(
+                _fill_lane(
                     batches[shard], lane, r, now_dt,
                     bool(use_cached[i]) if use_cached is not None else False,
                 )
-                if err is not None:
-                    errors[i] = err
-                    positions[i] = (-1, -1, -1)
-                    _clear_lane(batches[shard], lane)
         rounds.append(
             DeviceBatch(
                 *[
@@ -351,14 +347,13 @@ def _fill_lane(
     r: RateLimitReq,
     now_dt,
     use_cached: bool = False,
-) -> Optional[str]:
+) -> None:
+    """Fill one lane from a pre-validated request (Gregorian intervals were
+    checked before the round/lane was claimed, so this cannot fail)."""
     is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
     if is_greg:
-        try:
-            b.greg_expire[lane] = gregorian_expiration(now_dt, r.duration)
-            b.greg_duration[lane] = gregorian_duration(now_dt, r.duration)
-        except GregorianError as e:
-            return str(e)
+        b.greg_expire[lane] = gregorian_expiration(now_dt, r.duration)
+        b.greg_duration[lane] = gregorian_duration(now_dt, r.duration)
     b.key_hash[lane] = np.int64(np.uint64(key_hash64(r.hash_key())).view(np.int64))
     b.hits[lane] = r.hits
     b.limit[lane] = r.limit
@@ -370,9 +365,3 @@ def _fill_lane(
     b.is_greg[lane] = is_greg
     b.active[lane] = True
     b.use_cached[lane] = use_cached
-    return None
-
-
-def _clear_lane(b: DeviceBatch, lane: int) -> None:
-    for arr in b:
-        arr[lane] = 0

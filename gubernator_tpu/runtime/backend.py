@@ -133,11 +133,14 @@ class DeviceBackend:
         with self._lock:
             if self.store is not None:
                 self._seed_from_store(reqs, packed, now)
-            for db in packed.rounds:
-                self.table, resp = self._step(
-                    self.table, _to_device(db), np.int64(now)
-                )
-                round_resps.append(resp)
+            from gubernator_tpu.runtime.tracing import device_step_annotation
+
+            with device_step_annotation():
+                for db in packed.rounds:
+                    self.table, resp = self._step(
+                        self.table, _to_device(db), np.int64(now)
+                    )
+                    round_resps.append(resp)
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start

@@ -1,0 +1,194 @@
+"""Orbax-backed table checkpointing — TPU-native bulk persistence.
+
+The Store/Loader SPI (runtime/store.py) persists CacheItems one at a time,
+which round-trips every row through host python.  For large tables the
+natural TPU path is to checkpoint the device arrays themselves: orbax
+serializes the SlotTable pytree (plus the fingerprint->key map when key
+strings must survive) straight from device buffers.
+
+This powers two features the reference delegates to implementors
+(store.go:69-78, README.md:165-181):
+- fast restart warm-up: restore the whole table before serving;
+- periodic snapshots: a background loop checkpointing every N seconds
+  (crash recovery with bounded staleness — the acceptable-loss contract,
+  architecture.md:5-11, with a much smaller loss window).
+
+An `OrbaxLoader` adapter also plugs the checkpoint store into the standard
+Loader slot of Config for code written against the SPI.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import shutil
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from gubernator_tpu.core.types import CacheItem
+from gubernator_tpu.ops.state import table_from_host
+from gubernator_tpu.runtime.backend import DeviceBackend
+from gubernator_tpu.runtime.store import Loader
+
+log = logging.getLogger("gubernator_tpu.checkpoint")
+
+
+class TableCheckpointer:
+    """Save/restore a DeviceBackend's slot table with orbax."""
+
+    def __init__(self, directory: str) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:012d}")
+
+    def _complete_steps(self) -> List[int]:
+        """Steps with a fully written checkpoint.  Orbax temp dirs from a
+        crash mid-save ('step_N.orbax-checkpoint-tmp-...') and any other
+        non-integer suffixes are ignored, not fatal."""
+        steps = []
+        for d in os.listdir(self.directory):
+            if not d.startswith("step_"):
+                continue
+            suffix = d[len("step_"):]
+            if suffix.isdigit():
+                steps.append(int(suffix))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._complete_steps()
+        return steps[-1] if steps else None
+
+    def save(
+        self,
+        backend: DeviceBackend,
+        step: int,
+        keep: int = 3,
+    ) -> str:
+        """Checkpoint the table (and keymap when tracked); prunes old
+        steps beyond `keep`."""
+        with backend._lock:
+            table = backend.table
+            payload = {"table": {f: getattr(table, f) for f in table._fields}}
+        path = self._step_dir(step)
+        self._ckptr.save(path, payload, force=True)
+        if backend._keymap is not None:
+            with open(os.path.join(path, "keymap.json"), "w") as f:
+                json.dump(
+                    {str(k): v for k, v in backend._keymap.items()}, f
+                )
+        self._prune(keep)
+        log.info("checkpointed table to %s", path)
+        return path
+
+    def restore(self, backend: DeviceBackend, step: Optional[int] = None) -> int:
+        """Restore the table in place; returns the restored step."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        path = self._step_dir(step)
+        payload = self._ckptr.restore(path)
+        arrays = {
+            f: np.asarray(v) for f, v in payload["table"].items()
+        }
+        with backend._lock:
+            backend.table = table_from_host(arrays)
+        km_path = os.path.join(path, "keymap.json")
+        if os.path.exists(km_path) and backend._keymap is not None:
+            with open(km_path) as f:
+                backend._keymap.update(
+                    {int(k): v for k, v in json.load(f).items()}
+                )
+        log.info("restored table from %s", path)
+        return step
+
+    def _prune(self, keep: int) -> None:
+        """Drop all but the newest `keep` checkpoints (keep <= 0 keeps
+        only the newest one — the just-written snapshot)."""
+        steps = self._complete_steps()
+        cut = max(keep, 1)
+        for s in steps[:-cut]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
+class PeriodicCheckpointLoop:
+    """Background snapshot loop (bounded-staleness crash recovery)."""
+
+    def __init__(
+        self,
+        backend: DeviceBackend,
+        directory: str,
+        interval_s: float = 30.0,
+        keep: int = 3,
+    ) -> None:
+        self.ckptr = TableCheckpointer(directory)
+        self.backend = backend
+        self.interval_s = interval_s
+        self.keep = keep
+        self._task: Optional[asyncio.Task] = None
+        self._step = (self.ckptr.latest_step() or 0) + 1
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self, final_save: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        if final_save:
+            await self._save_once()
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            await self._save_once()
+
+    async def _save_once(self) -> None:
+        loop = asyncio.get_running_loop()
+        step = self._step
+        self._step += 1
+        try:
+            await loop.run_in_executor(
+                None, lambda: self.ckptr.save(self.backend, step, self.keep)
+            )
+        except Exception as e:  # noqa: BLE001
+            log.error("periodic checkpoint failed: %s", e)
+
+
+class OrbaxLoader(Loader):
+    """Loader SPI adapter over TableCheckpointer.
+
+    `load()` yields nothing itself — restore happens at table granularity
+    via `attach()`; `save()` likewise checkpoints the whole table.  Use
+    when code is wired for the Loader slot but orbax speed is wanted.
+    """
+
+    def __init__(self, directory: str) -> None:
+        self.ckptr = TableCheckpointer(directory)
+        self._backend: Optional[DeviceBackend] = None
+
+    def attach(self, backend: DeviceBackend) -> None:
+        self._backend = backend
+        try:
+            self.ckptr.restore(backend)
+        except FileNotFoundError:
+            pass
+
+    def load(self) -> Iterable[CacheItem]:
+        return []
+
+    def save(self, items: Iterator[CacheItem]) -> None:
+        if self._backend is not None:
+            step = (self.ckptr.latest_step() or 0) + 1
+            self.ckptr.save(self._backend, step)

@@ -56,6 +56,7 @@ from gubernator_tpu.net.replicated_hash import (
     RegionPicker,
     ReplicatedConsistentHash,
 )
+from gubernator_tpu.runtime import tracing
 from gubernator_tpu.runtime.backend import DeviceBackend
 
 log = logging.getLogger("gubernator_tpu.service")
@@ -114,6 +115,14 @@ class Service:
         self._dev_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-step"
         )
+        # Approximate tier for configured limit names (runtime/sketch_backend).
+        self.sketch_backend = None
+        if self.cfg.sketch is not None and self.cfg.sketch.names:
+            from gubernator_tpu.runtime.sketch_backend import SketchBackend
+
+            self.sketch_backend = SketchBackend(
+                self.cfg.sketch, clock=self.clock
+            )
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         self._closed = False
@@ -191,6 +200,7 @@ class Service:
             info,
             behavior=self.cfg.behaviors,
             channel_credentials=self._peer_credentials,
+            metrics=self.metrics,
         )
 
     def get_peer(self, key: str) -> PeerClient:
@@ -219,7 +229,10 @@ class Service:
         self._inflight_checks += 1
         self.metrics.concurrent_checks.observe(self._inflight_checks)
         try:
-            return await self._get_rate_limits(reqs)
+            with tracing.span(
+                "V1Instance.GetRateLimits", num_items=len(reqs)
+            ):
+                return await self._get_rate_limits(reqs)
         finally:
             self._inflight_checks -= 1
 
@@ -233,6 +246,28 @@ class Service:
         local_cached: List[bool] = []
         local_owner_meta: List[Optional[str]] = []
         forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
+
+        # Sketch-tier names don't compose with GLOBAL replication (the
+        # sketch is not broadcast); strip the flag so such requests route
+        # plainly to the key's owner and are counted ONCE there instead of
+        # locally-plus-forwarded (double counting).
+        if self.sketch_backend is not None:
+            from dataclasses import replace as dc_replace
+
+            reqs = [
+                dc_replace(
+                    r,
+                    behavior=Behavior(
+                        int(r.behavior) & ~int(Behavior.GLOBAL)
+                    ),
+                )
+                if (
+                    has_behavior(r.behavior, Behavior.GLOBAL)
+                    and self.sketch_backend.handles(r)
+                )
+                else r
+                for r in reqs
+            ]
 
         single_node = self.local_picker.size() == 0
         for i, req in enumerate(reqs):
@@ -318,6 +353,41 @@ class Service:
             if has_behavior(r.behavior, Behavior.MULTI_REGION):
                 self.multi_region_mgr.queue_hits(r)
         loop = asyncio.get_running_loop()
+        if self.sketch_backend is not None:
+            # Split off approximate-tier names; merge answers back in order.
+            sk_idx = [
+                i for i, r in enumerate(reqs)
+                if self.sketch_backend.handles(r)
+            ]
+            if sk_idx:
+                sk_set = set(sk_idx)
+                ex_idx = [i for i in range(len(reqs)) if i not in sk_set]
+                sk_resps = await loop.run_in_executor(
+                    self._dev_executor,
+                    lambda: self.sketch_backend.check(
+                        [reqs[i] for i in sk_idx]
+                    ),
+                )
+                ex_resps = (
+                    await loop.run_in_executor(
+                        self._dev_executor,
+                        lambda: self.backend.check(
+                            [reqs[i] for i in ex_idx],
+                            [
+                                use_cached[i] if use_cached else False
+                                for i in ex_idx
+                            ],
+                        ),
+                    )
+                    if ex_idx
+                    else []
+                )
+                out: List[Optional[RateLimitResp]] = [None] * len(reqs)
+                for j, i in enumerate(sk_idx):
+                    out[i] = sk_resps[j]
+                for j, i in enumerate(ex_idx):
+                    out[i] = ex_resps[j]
+                return out  # type: ignore[return-value]
         return await loop.run_in_executor(
             self._dev_executor,
             lambda: self.backend.check(reqs, use_cached),

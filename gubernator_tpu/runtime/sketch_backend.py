@@ -1,0 +1,117 @@
+"""Approximate-tier backend: serves selected limit names from the CMS.
+
+Wiring for ops/sketch.py at the service level: limits whose `name` is in
+`SketchTierConfig.names` (e.g. per-IP abuse limits with unbounded
+cardinality) are answered from the sliding-window count-min sketch instead
+of exact slots.  Memory is O(depth*width) regardless of key count — the
+100M-key tier (BASELINE.json) — at the cost of bounded over-limiting of
+hot-colliding keys (never under-limiting).
+
+Semantics differences from the exact tier, by design:
+- `remaining` is an estimate (limit - estimated_count, floored at 0);
+- duration selects the sliding window only at tier-config granularity
+  (`window_ms`), not per request — callers pick the tier per limit name;
+- hits are always counted, even over limit (abusers stay measured).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.core import clock as clock_mod
+from gubernator_tpu.core.config import SketchTierConfig
+from gubernator_tpu.core.types import RateLimitReq, RateLimitResp, Status
+
+
+class SketchBackend:
+    """CMS limiter over fixed-shape device batches."""
+
+    def __init__(
+        self,
+        cfg: SketchTierConfig,
+        clock: Optional[clock_mod.Clock] = None,
+    ) -> None:
+        from gubernator_tpu.ops.sketch import init_sketch, make_cms_step
+
+        self.cfg = cfg
+        self.clock = clock or clock_mod.default_clock()
+        self.state = init_sketch(
+            depth=cfg.depth, width=cfg.width, window_ms=cfg.window_ms
+        )
+        self._step = make_cms_step(use_pallas=cfg.use_pallas)
+        self._lock = threading.Lock()
+        self.batch = cfg.batch_size
+
+    def handles(self, req: RateLimitReq) -> bool:
+        return req.name in self.cfg.names
+
+    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        from gubernator_tpu import native
+
+        # Same validation contract as the exact packer
+        # (gubernator.go:228-237): errored requests get an error response
+        # and never touch the sketch (an empty unique_key would otherwise
+        # collide every such client on one shared bucket).
+        errors: dict = {}
+        valid: List[RateLimitReq] = []
+        for i, r in enumerate(reqs):
+            if not r.unique_key:
+                errors[i] = "field 'unique_key' cannot be empty"
+            elif not r.name:
+                errors[i] = "field 'namespace' cannot be empty"
+            else:
+                valid.append(r)
+        if errors:
+            inner = self.check(valid) if valid else []
+            out_all: List[RateLimitResp] = []
+            it = iter(inner)
+            for i in range(len(reqs)):
+                if i in errors:
+                    out_all.append(RateLimitResp(error=errors[i]))
+                else:
+                    out_all.append(next(it))
+            return out_all
+
+        n = len(reqs)
+        now = self.clock.millisecond_now()
+        hashes_all = native.hash_keys([r.hash_key() for r in reqs])
+        out: List[RateLimitResp] = []
+        window_ms = self.cfg.window_ms
+        for lo in range(0, n, self.batch):
+            chunk = reqs[lo:lo + self.batch]
+            pad = self.batch - len(chunk)
+            kh = np.concatenate(
+                [hashes_all[lo:lo + self.batch],
+                 np.zeros(pad, dtype=np.int64)]
+            )
+            hits = np.array(
+                [r.hits for r in chunk] + [0] * pad, dtype=np.int32
+            )
+            limits = np.array(
+                [r.limit for r in chunk] + [0] * pad, dtype=np.int32
+            )
+            with self._lock:
+                self.state, over, est = self._step(
+                    self.state, kh, hits, limits, np.int64(now)
+                )
+            over = np.asarray(over)
+            est = np.asarray(est)
+            win_start = int(np.asarray(self.state.window_start))
+            reset = win_start + window_ms
+            for j, r in enumerate(chunk):
+                e = int(est[j])
+                out.append(
+                    RateLimitResp(
+                        status=(
+                            Status.OVER_LIMIT if over[j]
+                            else Status.UNDER_LIMIT
+                        ),
+                        limit=r.limit,
+                        remaining=max(0, r.limit - e - max(r.hits, 0)),
+                        reset_time=reset,
+                        metadata={"tier": "sketch"},
+                    )
+                )
+        return out

@@ -1,0 +1,81 @@
+"""OpenTelemetry tracing — host spans around the request path.
+
+The reference wraps nearly every function in holster/OTel scopes
+(gubernator.go:118-121, workers.go:250-253, algorithms.go:32-35) and
+exports to Jaeger/OTLP via standard env vars (jaegertracing.md).  Here
+tracing is opt-in and degrades to no-ops when the SDK or an exporter is
+absent: `init_tracing()` wires the provider from OTEL_* env vars;
+`span(name)` is an async-context/decorator used by the service; device
+steps additionally get `jax.profiler.TraceAnnotation` marks so host spans
+line up with XLA traces in profiler dumps.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+log = logging.getLogger("gubernator_tpu.tracing")
+
+_tracer = None
+
+
+def init_tracing(service_name: str = "gubernator-tpu") -> bool:
+    """Initialize the OTel tracer provider from standard OTEL_* env vars
+    (OTEL_EXPORTER_OTLP_ENDPOINT, OTEL_TRACES_SAMPLER, ...).  Returns True
+    when tracing is active."""
+    global _tracer
+    try:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+    except ImportError:
+        log.info("opentelemetry SDK not available; tracing disabled")
+        return False
+
+    provider = TracerProvider(
+        resource=Resource.create({"service.name": service_name})
+    )
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if endpoint:
+        try:
+            from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+                OTLPSpanExporter,
+            )
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+            provider.add_span_processor(
+                BatchSpanProcessor(OTLPSpanExporter())
+            )
+        except ImportError:
+            log.warning(
+                "OTEL_EXPORTER_OTLP_ENDPOINT set but the OTLP exporter "
+                "package is missing; spans will not be exported"
+            )
+    trace.set_tracer_provider(provider)
+    _tracer = trace.get_tracer("gubernator_tpu")
+    return True
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[None]:
+    """Span context; no-op when tracing is uninitialized."""
+    if _tracer is None:
+        yield
+        return
+    with _tracer.start_as_current_span(name) as s:
+        for k, v in attrs.items():
+            s.set_attribute(k, v)
+        yield
+
+
+@contextlib.contextmanager
+def device_step_annotation(name: str = "gubernator_device_step"):
+    """XLA-profiler-visible annotation around a device step, nested in the
+    current OTel span when active."""
+    import jax
+
+    with span(name):
+        with jax.profiler.TraceAnnotation(name):
+            yield

@@ -1,0 +1,88 @@
+"""Approximate-tier service integration tests."""
+from __future__ import annotations
+
+import asyncio
+
+from gubernator_tpu.core.config import Config, DeviceConfig, SketchTierConfig
+from gubernator_tpu.core.types import RateLimitReq, Status
+from gubernator_tpu.runtime.service import Service
+
+DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_named_limits_route_to_sketch():
+    async def scenario():
+        cfg = Config(
+            device=DEV,
+            sketch=SketchTierConfig(
+                names=["per_ip"], width=1024, window_ms=60_000,
+                batch_size=128,
+            ),
+        )
+        svc = Service(cfg)
+        await svc.start()
+        # Mixed batch: exact-tier and sketch-tier names interleaved.
+        reqs = [
+            RateLimitReq(name="per_ip", unique_key="1.2.3.4", hits=2,
+                         limit=5, duration=60_000),
+            RateLimitReq(name="exact", unique_key="acct", hits=1,
+                         limit=10, duration=60_000),
+            RateLimitReq(name="per_ip", unique_key="5.6.7.8", hits=1,
+                         limit=5, duration=60_000),
+        ]
+        r = await svc.get_rate_limits(reqs)
+        assert r[0].metadata.get("tier") == "sketch"
+        assert r[0].status == Status.UNDER_LIMIT
+        assert r[0].remaining == 3
+        assert r[1].metadata.get("tier") is None
+        assert r[1].remaining == 9
+        assert r[2].remaining == 4
+
+        # Push one IP over its limit; the other stays under.
+        for _ in range(2):
+            r = await svc.get_rate_limits([
+                RateLimitReq(name="per_ip", unique_key="1.2.3.4", hits=2,
+                             limit=5, duration=60_000)
+            ])
+        assert r[0].status == Status.OVER_LIMIT
+        r = await svc.get_rate_limits([
+            RateLimitReq(name="per_ip", unique_key="5.6.7.8", hits=1,
+                         limit=5, duration=60_000)
+        ])
+        assert r[0].status == Status.UNDER_LIMIT
+        await svc.close()
+
+    run(scenario())
+
+
+def test_sketch_tier_unbounded_cardinality():
+    """Keys far beyond the exact table's capacity still get decisions."""
+    async def scenario():
+        cfg = Config(
+            device=DEV,  # exact table: only 4096 slots
+            sketch=SketchTierConfig(
+                names=["flood"], width=4096, window_ms=60_000,
+                batch_size=128,
+            ),
+        )
+        svc = Service(cfg)
+        await svc.start()
+        # 3 batches x 500 distinct keys > num_slots; every decision served.
+        for b in range(3):
+            reqs = [
+                RateLimitReq(name="flood", unique_key=f"ip{b}_{i}", hits=1,
+                             limit=100, duration=60_000)
+                for i in range(500)
+            ]
+            resps = await svc.get_rate_limits(reqs)
+            assert all(r.error == "" for r in resps)
+            assert all(r.metadata.get("tier") == "sketch" for r in resps)
+        # Exact-tier occupancy untouched by the flood.
+        assert svc.backend.occupancy() <= 2  # warmup key only
+        await svc.close()
+
+    run(scenario())
