@@ -8,6 +8,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 
 from gubernator_tpu.core.config import setup_daemon_config
@@ -22,9 +23,11 @@ def main() -> None:
     args = parser.parse_args()
 
     conf = setup_daemon_config(args.config or None)
-    logging.basicConfig(
-        level=getattr(logging, conf.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    from gubernator_tpu.core.logging import setup_logging
+
+    setup_logging(
+        level=conf.log_level,
+        fmt=os.environ.get("GUBER_LOG_FORMAT", "text"),
     )
     # OTel tracing from standard OTEL_* env vars (cmd/gubernator/main.go
     # initializes its tracer the same way, main.go:56-69).

@@ -237,6 +237,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         etcd_endpoints=_env("GUBER_ETCD_ENDPOINTS", "localhost:2379"),
         log_level=_env("GUBER_LOG_LEVEL", "info"),
         tls=tls,
+        # Bit 1 = process/platform/GC collectors (the GUBER_METRIC_FLAGS
+        # golang/process flags, daemon.go:255-266, flags.go:19-56).
+        metric_flags=_env_int("GUBER_METRIC_FLAGS", 0),
     )
 
 

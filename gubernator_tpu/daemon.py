@@ -119,6 +119,20 @@ class Daemon:
         self.tls: Optional[TLSBundle] = setup_tls(
             self.conf.tls, hostnames=("localhost", adv_host)
         )
+        if self.conf.metric_flags:
+            # Opt-in process/runtime collectors on the private registry
+            # (GUBER_METRIC_FLAGS, daemon.go:255-266).
+            from prometheus_client import (
+                GC_COLLECTOR,
+                PLATFORM_COLLECTOR,
+                PROCESS_COLLECTOR,
+            )
+
+            for c in (PROCESS_COLLECTOR, PLATFORM_COLLECTOR, GC_COLLECTOR):
+                try:
+                    self.metrics.registry.register(c)
+                except ValueError:
+                    pass  # another daemon in this process registered them
         self.service: Optional[Service] = None
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._http_runner: Optional[web.AppRunner] = None

@@ -1,0 +1,91 @@
+"""Discovery pool tests: DNS resolver pool and gossip-discovered daemons."""
+from __future__ import annotations
+
+import asyncio
+
+from gubernator_tpu.core.config import (
+    DaemonConfig,
+    DeviceConfig,
+    fast_test_behaviors,
+)
+from gubernator_tpu.core.types import RateLimitReq
+from gubernator_tpu.daemon import Daemon, wait_for_connect
+from gubernator_tpu.discovery.dns import DnsPool
+
+DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_dns_pool_resolves_localhost():
+    async def scenario():
+        got = []
+        pool = DnsPool(
+            "localhost",
+            lambda peers: got.append([p.grpc_address for p in peers]),
+            grpc_port=1051,
+            http_port=1050,
+            poll_interval_s=60.0,
+            own_address="127.0.0.1:1051",
+        )
+        await pool.start()
+        await pool.close()
+        return got
+
+    got = run(scenario())
+    assert got, "no update published"
+    assert any("127.0.0.1:1051" in peers for peers in got)
+
+
+def test_gossip_discovered_daemons_route():
+    """Two daemons find each other via gossip discovery and route
+    cross-peer traffic — the memberlist docker-compose scenario."""
+    async def scenario():
+        daemons = []
+        for i in range(2):
+            conf = DaemonConfig(
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                advertise_address="",  # resolve after bind
+                behaviors=fast_test_behaviors(),
+                device=DEV,
+                peer_discovery_type="gossip",
+                gossip_bind_address=f"127.0.0.1:{18200 + i}",
+                gossip_seeds=[] if i == 0 else ["127.0.0.1:18200"],
+            )
+            d = Daemon(conf)
+            # Daemons must advertise their concrete ephemeral port; start()
+            # assigns it, so set advertise before discovery publishes.
+            await d.start()
+            d.conf.advertise_address = d.grpc_address
+            daemons.append(d)
+        await wait_for_connect([d.grpc_address for d in daemons])
+
+        # Wait for gossip convergence: both daemons see 2 peers.
+        deadline = asyncio.get_running_loop().time() + 20.0
+        while True:
+            sizes = [d.service.local_picker.size() for d in daemons]
+            if all(s == 2 for s in sizes):
+                break
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError(f"gossip never converged: {sizes}")
+            await asyncio.sleep(0.2)
+
+        from gubernator_tpu.client import AsyncV1Client
+
+        cl = AsyncV1Client(daemons[0].grpc_address)
+        resps = await cl.get_rate_limits([
+            RateLimitReq(name="g", unique_key=f"k{i}", hits=1, limit=10,
+                         duration=60_000)
+            for i in range(32)
+        ])
+        owners = {r.metadata.get("owner", "local") for r in resps}
+        assert all(r.error == "" for r in resps)
+        assert len(owners) == 2, f"expected both daemons serving: {owners}"
+        await cl.close()
+        for d in daemons:
+            await d.close()
+
+    run(scenario())
