@@ -49,6 +49,7 @@ def pack_requests_sharded(
     batch_size: int,
     n_shards: int,
     clock: Optional[clock_mod.Clock] = None,
+    use_cached: Optional[Sequence[bool]] = None,
 ) -> PackedGrid:
     """Route each request to its owning shard and pack per-shard lanes.
 
@@ -62,6 +63,7 @@ def pack_requests_sharded(
         n_shards,
         lambda key: int(shard_of_hash(key_hash64(key), n_shards)),
         clock,
+        use_cached,
     )
 
 
@@ -83,6 +85,24 @@ def make_sharded_step(mesh, ways: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_sharded_cached_store(mesh, ways: int):
+    """Sharded GLOBAL broadcast receive: each shard upserts its routed
+    KIND_CACHED_RESP rows (gubernator.go:464-479 over the mesh)."""
+    from gubernator_tpu.ops.step import CachedRows, store_cached_rows_impl
+
+    def _local(table: SlotTable, rows: CachedRows, now):
+        r = CachedRows(*[a[0] for a in rows])
+        return store_cached_rows_impl(table, r, now, ways=ways)
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=P(SHARD_AXIS),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 class MeshBackend:
     """Drop-in peer of runtime.backend.DeviceBackend over a device mesh."""
 
@@ -91,7 +111,17 @@ class MeshBackend:
         cfg: DeviceConfig,
         clock: Optional[clock_mod.Clock] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        metrics=None,
+        store=None,
+        track_keys: bool = False,
     ) -> None:
+        if store is not None or track_keys:
+            raise NotImplementedError(
+                "the Store/Loader SPI is single-device for now; use "
+                "TableCheckpointer for mesh persistence"
+            )
+        self.metrics = metrics
+        self.store = None
         if cfg.num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         self.cfg = cfg
@@ -110,6 +140,7 @@ class MeshBackend:
             init_table(cfg.num_slots), self._tsharding
         )
         self._step = make_sharded_step(self.mesh, cfg.ways)
+        self._cached_store = make_sharded_cached_store(self.mesh, cfg.ways)
         self.checks = 0
         self.over_limit = 0
         self.not_persisted = 0
@@ -119,11 +150,27 @@ class MeshBackend:
             self.checks += tally.checks
             self.over_limit += tally.over_limit
             self.not_persisted += tally.not_persisted
+        m = self.metrics
+        if m is not None:
+            m.check_counter.inc(tally.checks)
+            if tally.over_limit:
+                m.over_limit_counter.inc(tally.over_limit)
+            if tally.not_persisted:
+                m.unexpired_evictions.inc(tally.not_persisted)
+            m.cache_access_count.labels(type="hit").inc(tally.cache_hits)
+            m.cache_access_count.labels(type="miss").inc(
+                tally.checks - tally.cache_hits
+            )
 
     # -- hot path --------------------------------------------------------
-    def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+    def check(
+        self,
+        reqs: Sequence[RateLimitReq],
+        use_cached: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
         packed = pack_requests_sharded(
-            reqs, self.cfg.batch_size, self.cfg.num_shards, self.clock
+            reqs, self.cfg.batch_size, self.cfg.num_shards, self.clock,
+            use_cached,
         )
         now = np.int64(self.clock.millisecond_now())
 
@@ -141,6 +188,68 @@ class MeshBackend:
         )
         self._add_tally(tally)
         return out
+
+    def warmup(self) -> None:
+        """Compile the sharded step executables before serving."""
+        reqs = [
+            RateLimitReq(name="__warmup__", unique_key=f"w{s}", hits=0,
+                         limit=1, duration=1)
+            for s in range(self.cfg.num_shards)
+        ]
+        r = self.check(reqs)
+        del r
+        self.apply_cached_rows([])
+
+    # -- GLOBAL broadcast receive ----------------------------------------
+    def apply_cached_rows(self, rows: Sequence[tuple]) -> None:
+        """Upsert owner-broadcast statuses, routed to their shards: rows of
+        (hash_key_str, algorithm, limit, remaining, status, reset_time)."""
+        from gubernator_tpu.ops.step import CachedRows
+
+        n, B = self.cfg.num_shards, self.cfg.batch_size
+        now = np.int64(self.clock.millisecond_now())
+        # Route rows to shards; chunk any shard overflow into extra passes.
+        per_shard: List[List[tuple]] = [[] for _ in range(n)]
+        for row in rows:
+            h = key_hash64(row[0])
+            per_shard[int(shard_of_hash(h, n))].append(row)
+        while True:
+            grid = CachedRows(
+                key_hash=np.zeros((n, B), dtype=np.int64),
+                algo=np.zeros((n, B), dtype=np.int32),
+                limit=np.zeros((n, B), dtype=np.int64),
+                remaining=np.zeros((n, B), dtype=np.int64),
+                status=np.zeros((n, B), dtype=np.int32),
+                reset_time=np.zeros((n, B), dtype=np.int64),
+            )
+            any_filled = False
+            for s in range(n):
+                take, per_shard[s] = per_shard[s][:B], per_shard[s][B:]
+                for lane, (key, algo, limit, rem, status, reset) in (
+                    enumerate(take)
+                ):
+                    grid.key_hash[s, lane] = np.int64(
+                        np.uint64(key_hash64(key)).view(np.int64)
+                    )
+                    grid.algo[s, lane] = algo
+                    grid.limit[s, lane] = limit
+                    grid.remaining[s, lane] = rem
+                    grid.status[s, lane] = status
+                    grid.reset_time[s, lane] = reset
+                    any_filled = True
+            with self._lock:
+                self.table = self._cached_store(
+                    self.table,
+                    CachedRows(
+                        *[
+                            jax.device_put(a, self._bsharding)
+                            for a in grid
+                        ]
+                    ),
+                    now,
+                )
+            if not any_filled or not any(per_shard):
+                break
 
     # -- point reads / persistence ---------------------------------------
     def bucket_offset(self, key: str, shard: int) -> int:

@@ -91,13 +91,28 @@ class Service:
         self.cfg = cfg or Config()
         self.clock = clock or clock_mod.default_clock()
         self.metrics = metrics or Metrics()
-        self.backend = backend or DeviceBackend(
-            self.cfg.device,
-            clock=self.clock,
-            store=self.cfg.store,
-            track_keys=(self.cfg.loader is not None),
-            metrics=self.metrics,
-        )
+        if backend is not None:
+            self.backend = backend
+        elif self.cfg.device.num_shards > 1:
+            # Multi-chip: shard the table over the device mesh.  (Store/
+            # Loader SPI is single-device; use TableCheckpointer there.)
+            from gubernator_tpu.parallel.sharded import MeshBackend
+
+            self.backend = MeshBackend(
+                self.cfg.device,
+                clock=self.clock,
+                metrics=self.metrics,
+                store=self.cfg.store,
+                track_keys=(self.cfg.loader is not None),
+            )
+        else:
+            self.backend = DeviceBackend(
+                self.cfg.device,
+                clock=self.clock,
+                store=self.cfg.store,
+                track_keys=(self.cfg.loader is not None),
+                metrics=self.metrics,
+            )
         self._inflight_checks = 0
         self._peer_credentials = peer_credentials
         hash_fn = HASH_FUNCTIONS[self.cfg.local_picker_hash]

@@ -1,0 +1,82 @@
+"""Service over the mesh-sharded backend (num_shards > 1 on the virtual
+8-device CPU mesh) — the multi-chip daemon configuration."""
+from __future__ import annotations
+
+import asyncio
+
+from gubernator_tpu.core.config import Config, DeviceConfig
+from gubernator_tpu.core.types import (
+    Algorithm,
+    RateLimitReq,
+    Status,
+    UpdatePeerGlobal,
+    RateLimitResp,
+)
+from gubernator_tpu.runtime.service import Service
+
+MESH_DEV = DeviceConfig(
+    num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_service_on_mesh_backend():
+    async def scenario():
+        svc = Service(Config(device=MESH_DEV))
+        await svc.start()
+        from gubernator_tpu.parallel.sharded import MeshBackend
+
+        assert isinstance(svc.backend, MeshBackend)
+        reqs = [
+            RateLimitReq(name="mesh", unique_key=f"k{i}", hits=1, limit=10,
+                         duration=60_000)
+            for i in range(100)
+        ]
+        r1 = await svc.get_rate_limits(reqs)
+        assert all(x.error == "" for x in r1)
+        assert all(x.remaining == 9 for x in r1)
+        r2 = await svc.get_rate_limits(reqs)
+        assert all(x.remaining == 8 for x in r2)
+        # Validation contract holds on the mesh path too.
+        bad = await svc.get_rate_limits(
+            [RateLimitReq(name="", unique_key="x", hits=1, limit=1,
+                          duration=1000)]
+        )
+        assert bad[0].error == "field 'namespace' cannot be empty"
+        await svc.close()
+
+    run(scenario())
+
+
+def test_mesh_global_broadcast_receive():
+    """UpdatePeerGlobals lands in the sharded cache and serves use_cached
+    reads (the GLOBAL non-owner path on a mesh daemon)."""
+    async def scenario():
+        svc = Service(Config(device=MESH_DEV))
+        await svc.start()
+        await svc.update_peer_globals([
+            UpdatePeerGlobal(
+                key=f"g_cache{i}",
+                status=RateLimitResp(
+                    status=Status.OVER_LIMIT, limit=50, remaining=0,
+                    reset_time=svc.clock.millisecond_now() + 60_000,
+                ),
+                algorithm=Algorithm.TOKEN_BUCKET,
+            )
+            for i in range(40)
+        ])
+        # use_cached reads serve the broadcast verbatim.
+        reqs = [
+            RateLimitReq(name="g", unique_key=f"cache{i}", hits=1,
+                         limit=50, duration=60_000)
+            for i in range(40)
+        ]
+        resps = await svc._check_local(reqs, [True] * 40)
+        assert all(r.status == Status.OVER_LIMIT for r in resps)
+        assert all(r.remaining == 0 for r in resps)
+        await svc.close()
+
+    run(scenario())
