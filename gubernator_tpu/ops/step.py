@@ -537,3 +537,32 @@ def store_cached_rows_impl(
 store_cached_rows = jax.jit(
     store_cached_rows_impl, static_argnames=("ways",), donate_argnums=(0,)
 )
+
+
+def apply_batch_packed_impl(
+    table: SlotTable,
+    batch: DeviceBatchJ,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[SlotTable, jax.Array]:
+    """apply_batch with the response packed into ONE int64[6, B] array —
+    a single device->host transfer per step instead of six.  Matters when
+    the host link has per-transfer latency (e.g. remote-device tunnels).
+
+    Rows: status, limit, remaining, reset_time, persisted, found.
+    """
+    new_table, r = apply_batch_impl(table, batch, now, ways)
+    packed = jnp.stack([
+        r.status.astype(jnp.int64),
+        r.limit.astype(jnp.int64),
+        r.remaining.astype(jnp.int64),
+        r.reset_time.astype(jnp.int64),
+        r.persisted.astype(jnp.int64),
+        r.found.astype(jnp.int64),
+    ])
+    return new_table, packed
+
+
+apply_batch_packed = jax.jit(
+    apply_batch_packed_impl, static_argnames=("ways",), donate_argnums=(0,)
+)

@@ -38,6 +38,7 @@ from gubernator_tpu.ops.step import (
     CachedRows,
     DeviceBatchJ,
     apply_batch,
+    apply_batch_packed,
     load_rows,
     probe_batch,
     store_cached_rows,
@@ -66,6 +67,9 @@ class DeviceBackend:
         with jax.default_device(self._device):
             self.table: SlotTable = init_table(self.cfg.num_slots)
         self._step = functools.partial(apply_batch, ways=self.cfg.ways)
+        self._step_packed = functools.partial(
+            apply_batch_packed, ways=self.cfg.ways
+        )
         self._load_rows = functools.partial(load_rows, ways=self.cfg.ways)
         self._probe = functools.partial(probe_batch, ways=self.cfg.ways)
         # Module-level jits (apply_batch/load_rows/probe_batch/
@@ -137,19 +141,19 @@ class DeviceBackend:
 
             with device_step_annotation():
                 for db in packed.rounds:
-                    self.table, resp = self._step(
+                    self.table, packed_resp = self._step_packed(
                         self.table, _to_device(db), np.int64(now)
                     )
-                    round_resps.append(resp)
+                    round_resps.append(packed_resp)
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start
             )
             self.metrics.pool_queue_length.observe(len(reqs))
-        # One sync at the end of all rounds.
+        # One packed sync per round (one transfer instead of six).
         out, tally = unmarshal_responses(
             len(reqs), packed.errors, packed.positions,
-            resp_rounds_to_host(round_resps),
+            packed_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
         if self.store is not None:
@@ -492,6 +496,23 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
         }
         for r in round_resps
     ]
+
+
+def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
+    """Host view of packed int64[6, B] responses (apply_batch_packed row
+    order), one transfer per round."""
+    out = []
+    for p in round_packed:
+        a = np.asarray(p)
+        out.append({
+            "status": a[0],
+            "limit": a[1],
+            "remaining": a[2],
+            "reset_time": a[3],
+            "persisted": a[4],
+            "found": a[5],
+        })
+    return out
 
 
 def unmarshal_responses(
