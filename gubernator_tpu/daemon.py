@@ -38,6 +38,46 @@ _GRPC_CODES = {
 }
 
 
+class _StatsInterceptor(grpc.aio.ServerInterceptor):
+    """Per-RPC count + duration + failed for EVERY server method — the
+    analog of the reference's grpc.StatsHandler, which tags each RPC and
+    records both services uniformly (grpc_stats.go:41-145), not just
+    V1/GetRateLimits."""
+
+    def __init__(self, metrics: Metrics) -> None:
+        self.metrics = metrics
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = handler_call_details.method
+        inner = handler.unary_unary
+        m = self.metrics
+
+        async def wrapped(request, context):
+            start = time.monotonic()
+            failed = "false"
+            try:
+                return await inner(request, context)
+            except BaseException:
+                failed = "true"
+                raise
+            finally:
+                m.grpc_request_counts.labels(
+                    method=method, failed=failed
+                ).inc()
+                m.grpc_request_duration.labels(method=method).observe(
+                    time.monotonic() - start
+                )
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
 class _V1Servicer:
     """pb2 <-> Service adapter for the client-facing V1 service."""
 
@@ -45,28 +85,16 @@ class _V1Servicer:
         self.d = daemon
 
     async def GetRateLimits(self, request, context):
-        m = self.d.metrics
-        start = time.monotonic()
-        failed = "false"
+        reqs = grpc_api.reqs_from_pb(request.requests)
         try:
-            reqs = grpc_api.reqs_from_pb(request.requests)
-            try:
-                resps = await self.d.service.get_rate_limits(reqs)
-            except ApiError as e:
-                failed = "true"
-                await context.abort(
-                    _GRPC_CODES.get(e.code, grpc.StatusCode.INTERNAL), str(e)
-                )
-            return pb.GetRateLimitsResp(
-                responses=grpc_api.resps_to_pb(resps)
+            resps = await self.d.service.get_rate_limits(reqs)
+        except ApiError as e:
+            await context.abort(
+                _GRPC_CODES.get(e.code, grpc.StatusCode.INTERNAL), str(e)
             )
-        finally:
-            m.grpc_request_counts.labels(
-                method="/pb.gubernator.V1/GetRateLimits", failed=failed
-            ).inc()
-            m.grpc_request_duration.labels(
-                method="/pb.gubernator.V1/GetRateLimits"
-            ).observe(time.monotonic() - start)
+        return pb.GetRateLimitsResp(
+            responses=grpc_api.resps_to_pb(resps)
+        )
 
     async def HealthCheck(self, request, context):
         h = await self.d.service.health_check()
@@ -163,10 +191,14 @@ class Daemon:
         await self.service.start()
 
         # gRPC server (daemon.go:101-126): both services on one listener.
+        # 4MB recv cap: grpc-go's default, which reference peers assume.
+        # Count-capped peer batches (batch_limit=1000) with long key strings
+        # can pass 1MB, and a rejected batch fails every flush window.
         server = grpc.aio.server(
             options=[
-                ("grpc.max_receive_message_length", 1024 * 1024),  # 1MB cap
-            ]
+                ("grpc.max_receive_message_length", 4 * 1024 * 1024),
+            ],
+            interceptors=[_StatsInterceptor(self.metrics)],
         )
         server.add_generic_rpc_handlers((
             grpc_api.v1_generic_handler(_V1Servicer(self)),

@@ -34,13 +34,14 @@ log = logging.getLogger("gubernator_tpu.discovery.gossip")
 
 
 class _Member:
-    __slots__ = ("info", "incarnation", "last_heard", "dead")
+    __slots__ = ("info", "incarnation", "last_heard", "dead", "pinged_at")
 
     def __init__(self, info: PeerInfo, incarnation: int) -> None:
         self.info = info
         self.incarnation = incarnation
         self.last_heard = time.monotonic()
         self.dead = False
+        self.pinged_at: Optional[float] = None
 
 
 class GossipPool(Pool, asyncio.DatagramProtocol):
@@ -132,19 +133,44 @@ class GossipPool(Pool, asyncio.DatagramProtocol):
                 for seed in self.seeds:
                     self._send_state(seed)
 
+    def _suspect_threshold(self) -> float:
+        """Suspicion window scaled with cluster size (memberlist-style).
+
+        With full-state push to `fanout` random targets per interval, a
+        given peer contacts us directly about every (n-1)/fanout rounds in
+        expectation — a fixed window churns live nodes at tens of peers
+        (P[no contact in 5 rounds] ~ 42% at n=20).  Three expected contact
+        periods keeps the false-positive rate low at any n.
+        """
+        n = sum(1 for m in self._members.values() if not m.dead)
+        return max(
+            self.suspect_after_s,
+            3.0 * self.gossip_interval_s * max(1.0, (n - 1) / self.fanout),
+        )
+
     def _expire(self) -> None:
         now = time.monotonic()
+        suspect_s = self._suspect_threshold()
         changed = False
         for addr, m in list(self._members.items()):
             if addr == self.self_addr:
                 m.last_heard = now
                 continue
             age = now - m.last_heard
-            if not m.dead and age > self.suspect_after_s:
-                m.dead = True
-                changed = True
-                log.info("gossip: %s suspected dead", addr)
-            if m.dead and age > self.reap_after_s:
+            if age <= suspect_s:
+                m.pinged_at = None
+            elif not m.dead:
+                if m.pinged_at is None:
+                    # Direct probe before declaring death (SWIM's ping):
+                    # a live node acks with its state, refreshing
+                    # last_heard before the grace below expires.
+                    m.pinged_at = now
+                    self._send_ping(addr)
+                elif now - m.pinged_at > 2.0 * self.gossip_interval_s:
+                    m.dead = True
+                    changed = True
+                    log.info("gossip: %s suspected dead", addr)
+            if m.dead and age > suspect_s + self.reap_after_s:
                 del self._members[addr]
                 changed = True
         if changed:
@@ -168,6 +194,12 @@ class GossipPool(Pool, asyncio.DatagramProtocol):
     def _send_state(self, addr: str) -> None:
         self._sendto(self._state_msg(), addr)
 
+    def _send_ping(self, addr: str) -> None:
+        self._sendto(
+            json.dumps({"type": "ping", "from": self.self_addr}).encode(),
+            addr,
+        )
+
     def _sendto(self, data: bytes, addr: str) -> None:
         if self._transport is None:
             return
@@ -181,6 +213,23 @@ class GossipPool(Pool, asyncio.DatagramProtocol):
         try:
             msg = json.loads(data.decode())
         except (ValueError, UnicodeDecodeError):
+            return
+        if msg.get("type") == "ping":
+            # Ack with our full state: the sender refreshes our liveness
+            # from the `from` field and syncs membership in one packet.
+            # A ping is direct contact — it also resurrects a member we
+            # had marked dead (otherwise a pinging peer sits in dead-limbo:
+            # last_heard keeps refreshing so it never reaps, but it never
+            # rejoins the published peer list either).
+            src = msg.get("from")
+            if src:
+                m = self._members.get(src)
+                if m is not None:
+                    m.last_heard = time.monotonic()
+                    if m.dead:
+                        m.dead = False
+                        self._publish()
+                self._send_state(src)
             return
         if msg.get("type") == "leave":
             addr = msg.get("addr")

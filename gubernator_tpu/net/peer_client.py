@@ -65,6 +65,12 @@ class PeerClient:
             asyncio.Queue(maxsize=1000)
         )
         self._batcher_task: Optional[asyncio.Task] = None
+        # Bound concurrent batch RPCs: the reference serializes sends
+        # through one sendQueue goroutine (peer_client.go:450-509); we allow
+        # a small window of overlap but never unbounded fan-out — under a
+        # stalled peer the batcher blocks here, the queue fills, and new
+        # enqueues shed with PeerNotReadyError (backpressure, not pile-up).
+        self._send_sem = asyncio.Semaphore(4)
         self._shutdown = False
         self._inflight = 0
         self._drained = asyncio.Event()
@@ -152,6 +158,14 @@ class PeerClient:
             return await self._call_get_peer_rate_limits(reqs)
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
+            if e.code() in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.CANCELLED,
+            ):
+                # Same conversion as the single-request path: UNAVAILABLE
+                # here is almost always connect-refused (owner restarting),
+                # i.e. the batch never reached the peer.
+                raise PeerNotReadyError(str(e)) from e
             raise
         finally:
             self._track_inflight(-1)
@@ -241,6 +255,7 @@ class PeerClient:
                 except asyncio.TimeoutError:
                     break
                 batch.append(item)
+            await self._send_sem.acquire()
             asyncio.ensure_future(self._send_batch(batch))
 
     async def _send_batch(
@@ -254,6 +269,12 @@ class PeerClient:
             self.metrics.queue_length.labels(
                 peerAddr=self.peer_info.grpc_address
             ).observe(len(batch))
+        try:
+            await self._send_batch_inner(batch, reqs, start)
+        finally:
+            self._send_sem.release()
+
+    async def _send_batch_inner(self, batch, reqs, start) -> None:
         try:
             resps = await self._call_get_peer_rate_limits(reqs)
             if self.metrics is not None:

@@ -37,7 +37,6 @@ from gubernator_tpu.ops.step import (
     BucketRows,
     CachedRows,
     DeviceBatchJ,
-    apply_batch,
     apply_batch_packed,
     load_rows,
     probe_batch,
@@ -66,13 +65,12 @@ class DeviceBackend:
             self._device = jax.devices()[0]
         with jax.default_device(self._device):
             self.table: SlotTable = init_table(self.cfg.num_slots)
-        self._step = functools.partial(apply_batch, ways=self.cfg.ways)
         self._step_packed = functools.partial(
             apply_batch_packed, ways=self.cfg.ways
         )
         self._load_rows = functools.partial(load_rows, ways=self.cfg.ways)
         self._probe = functools.partial(probe_batch, ways=self.cfg.ways)
-        # Module-level jits (apply_batch/load_rows/probe_batch/
+        # Module-level jits (apply_batch_packed/load_rows/probe_batch/
         # store_cached_rows) share one compile cache across backends — the
         # in-process cluster fixture runs many daemons per process and
         # per-instance jits would recompile per daemon.
@@ -187,8 +185,12 @@ class DeviceBackend:
             self.clock,
         )
         with self._lock:
+            # Compile the packed step — check()'s actual hot path — so the
+            # first client request never pays the cold XLA compile.
             for db in packed.rounds:
-                self.table, resp = self._step(self.table, _to_device(db), now)
+                self.table, resp = self._step_packed(
+                    self.table, _to_device(db), now
+                )
             # Fixed-shape probe executable (store seeding / bulk reads).
             self._probe(
                 self.table,

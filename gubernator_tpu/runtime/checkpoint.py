@@ -28,7 +28,7 @@ from typing import Iterable, Iterator, List, Optional
 import numpy as np
 
 from gubernator_tpu.core.types import CacheItem
-from gubernator_tpu.ops.state import table_from_host
+from gubernator_tpu.ops.state import table_from_host, table_to_host
 from gubernator_tpu.runtime.backend import DeviceBackend
 from gubernator_tpu.runtime.store import Loader
 
@@ -73,16 +73,19 @@ class TableCheckpointer:
     ) -> str:
         """Checkpoint the table (and keymap when tracked); prunes old
         steps beyond `keep`."""
+        # Copy to host while holding the lock: the step functions donate the
+        # table buffers, so a concurrent check() would delete the captured
+        # device arrays mid-serialization ("Array has been deleted").
         with backend._lock:
-            table = backend.table
-            payload = {"table": {f: getattr(table, f) for f in table._fields}}
+            payload = {"table": dict(table_to_host(backend.table))}
+            keymap = (
+                dict(backend._keymap) if backend._keymap is not None else None
+            )
         path = self._step_dir(step)
         self._ckptr.save(path, payload, force=True)
-        if backend._keymap is not None:
+        if keymap is not None:
             with open(os.path.join(path, "keymap.json"), "w") as f:
-                json.dump(
-                    {str(k): v for k, v in backend._keymap.items()}, f
-                )
+                json.dump({str(k): v for k, v in keymap.items()}, f)
         self._prune(keep)
         log.info("checkpointed table to %s", path)
         return path

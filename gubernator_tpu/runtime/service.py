@@ -434,6 +434,14 @@ class Service:
                 last_err = e
                 attempts += 1
                 self.metrics.asyncrequest_retries.labels(req.name).inc()
+                if attempts > ASYNC_RETRIES:
+                    continue  # exhausted — no pointless final backoff
+                # Back off before re-resolving: immediate retries against a
+                # dying peer all complete before any discovery update can
+                # land (the reference retries after the peer's reconnect
+                # backoff).  Exponential 10ms..160ms keeps total added
+                # latency under the 500ms batch timeout.
+                await asyncio.sleep(min(0.01 * (2 ** (attempts - 1)), 0.16))
                 try:
                     peer = self.get_peer(key)
                 except PoolEmptyError as pe:
@@ -690,17 +698,26 @@ class GlobalManager:
                         timeout=self.timeout_s,
                     )
                     self.async_sends += 1
-                except Exception as e:  # noqa: BLE001
-                    log.error(
-                        "error sending global hits to '%s': %s",
+                except PeerNotReadyError as e:
+                    # Shutdown / queue-full provably precede any send, so
+                    # re-queueing cannot double count; a transiently
+                    # unreachable owner keeps the window's hits
+                    # (aggregation bounds the backlog by unique keys).
+                    log.warning(
+                        "re-queueing global hits for '%s': %s",
                         peer.info().grpc_address, e,
                     )
-                    # Re-queue so a transiently unreachable owner doesn't
-                    # lose the window's hits (improvement over the
-                    # reference, which drops them — global.go:152-162);
-                    # aggregation bounds the backlog by unique keys.
                     for r in chunk:
                         self.queue_hit(r)
+                except Exception as e:  # noqa: BLE001
+                    # Timeout or mid-RPC failure: the owner MAY have applied
+                    # the batch already — re-sending would double count.
+                    # Drop, like the reference (global.go:152-162); the next
+                    # live hit re-syncs the key.
+                    log.error(
+                        "dropping global hits for '%s': %s",
+                        peer.info().grpc_address, e,
+                    )
 
         # Fan out per peer — one slow peer must not delay the others.
         await asyncio.gather(

@@ -361,6 +361,48 @@ def test_http_gateway_contract(cluster):
     assert "gubernator_tpu_device_step_duration" in text
 
 
+def test_grpc_stats_cover_all_methods(cluster):
+    """The stats interceptor records count + duration for EVERY RPC method
+    — peers side included, where all forwarded traffic rides (the reference
+    StatsHandler tags each RPC uniformly, grpc_stats.go:98-131)."""
+    import grpc
+
+    d = cluster.daemon_at(2)
+    cl = V1Client(d.grpc_address)
+    cl.get_rate_limits([
+        RateLimitReq(
+            name="test_stats", unique_key="s", hits=1, limit=10,
+            duration=60_000,
+        )
+    ])
+    cl.health_check()
+    cl.close()
+    ch = grpc.insecure_channel(d.grpc_address)
+    stub = PeersV1Stub(ch)
+    stub.GetPeerRateLimits(peers_pb2.GetPeerRateLimitsReq(
+        requests=[req_to_pb(RateLimitReq(
+            name="test_stats", unique_key="p", hits=1, limit=10,
+            duration=60_000,
+        ))]
+    ))
+    stub.UpdatePeerGlobals(peers_pb2.UpdatePeerGlobalsReq())
+    ch.close()
+
+    with urllib.request.urlopen(
+        f"http://{d.http_address}/metrics", timeout=10
+    ) as resp:
+        text = resp.read().decode()
+    assert "gubernator_grpc_request_counts" in text
+    assert "gubernator_grpc_request_duration" in text
+    for method in (
+        "/pb.gubernator.V1/GetRateLimits",
+        "/pb.gubernator.V1/HealthCheck",
+        "/pb.gubernator.PeersV1/GetPeerRateLimits",
+        "/pb.gubernator.PeersV1/UpdatePeerGlobals",
+    ):
+        assert f'method="{method}"' in text, method
+
+
 def test_multi_region_hits_propagate(cluster):
     """MULTI_REGION hits flush to the owner in the other region (the tier
     the reference leaves stubbed, multiregion.go:96-98 — implemented

@@ -1,0 +1,114 @@
+"""GlobalManager flush semantics: a timed-out send must NOT re-queue its
+hits (the owner may have applied them — re-sending double counts), while a
+provably-unsent batch (PeerNotReadyError) must be retried.
+
+Reference contrast: global.go:152-162 drops on any failure; we keep hits
+only when the failure provably preceded the send.
+"""
+from __future__ import annotations
+
+import asyncio
+from types import SimpleNamespace
+
+from gubernator_tpu.core.config import BehaviorConfig, Config
+from gubernator_tpu.core.types import Behavior, PeerInfo, RateLimitReq
+from gubernator_tpu.net.peer_client import PeerNotReadyError
+from gubernator_tpu.runtime.metrics import Metrics
+from gubernator_tpu.runtime.service import GlobalManager
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class FakePeer:
+    """Owner stand-in: applies the batch, then optionally stalls or fails."""
+
+    def __init__(self, mode: str, stall_s: float = 0.0):
+        self.mode = mode  # "ok" | "stall_after_apply" | "not_ready"
+        self.stall_s = stall_s
+        self.applied = []  # (key, hits) per received request
+
+    def info(self) -> PeerInfo:
+        return PeerInfo(grpc_address="fake:1234")
+
+    async def get_peer_rate_limits_batch(self, reqs):
+        if self.mode == "not_ready":
+            # Shed BEFORE any send — the queue-full / shutdown path.
+            raise PeerNotReadyError("queue full")
+        for r in reqs:
+            self.applied.append((r.hash_key(), r.hits))
+        if self.mode == "stall_after_apply":
+            # The RPC was delivered and applied, but the response is late:
+            # the caller's wait_for times out.
+            await asyncio.sleep(self.stall_s)
+        return []
+
+
+def _manager(peer: FakePeer, timeout_s: float = 0.05) -> GlobalManager:
+    behaviors = BehaviorConfig(
+        global_sync_wait_s=0.001,
+        global_timeout_s=timeout_s,
+    )
+    svc = SimpleNamespace(
+        cfg=Config(behaviors=behaviors),
+        metrics=Metrics(),
+        get_peer=lambda key: peer,
+    )
+    return GlobalManager(svc)  # type: ignore[arg-type]
+
+
+def _req(key: str, hits: int = 3) -> RateLimitReq:
+    return RateLimitReq(
+        name="g", unique_key=key, hits=hits, limit=100,
+        duration=60_000, behavior=Behavior.GLOBAL,
+    )
+
+
+def test_timeout_does_not_double_apply():
+    """A send that times out after the owner applied it is DROPPED, not
+    re-queued: re-sending would count the same hits twice."""
+    async def scenario():
+        peer = FakePeer("stall_after_apply", stall_s=0.5)
+        mgr = _manager(peer, timeout_s=0.05)
+        mgr.queue_hit(_req("a", hits=3))
+        hits, mgr._hits = dict(mgr._hits), {}
+        await mgr._send_hits(hits)
+        # Applied exactly once on the owner...
+        assert peer.applied == [("g_a", 3)]
+        # ...and nothing was re-queued for a second application.
+        assert mgr._hits == {}
+        assert mgr.async_sends == 0
+
+    run(scenario())
+
+
+def test_not_ready_requeues_hits():
+    """A pre-send failure (peer shutting down / queue full) keeps the
+    window's hits for the next flush — nothing was delivered, so the retry
+    cannot double count."""
+    async def scenario():
+        peer = FakePeer("not_ready")
+        mgr = _manager(peer)
+        mgr.queue_hit(_req("b", hits=2))
+        hits, mgr._hits = dict(mgr._hits), {}
+        await mgr._send_hits(hits)
+        assert peer.applied == []
+        assert "g_b" in mgr._hits and mgr._hits["g_b"].hits == 2
+
+    run(scenario())
+
+
+def test_successful_send_counts_once():
+    async def scenario():
+        peer = FakePeer("ok")
+        mgr = _manager(peer)
+        mgr.queue_hit(_req("c", hits=1))
+        mgr.queue_hit(_req("c", hits=4))  # same key aggregates
+        hits, mgr._hits = dict(mgr._hits), {}
+        await mgr._send_hits(hits)
+        assert peer.applied == [("g_c", 5)]
+        assert mgr._hits == {}
+        assert mgr.async_sends == 1
+
+    run(scenario())

@@ -75,6 +75,90 @@ def test_shutdown_races_inflight_requests(behavior):
     run(scenario())
 
 
+def test_backpressure_bounds_inflight_sends():
+    """Under a stalled peer, concurrent batch RPCs cap at the send
+    semaphore (4) and the queue sheds excess enqueues with
+    PeerNotReadyError instead of accumulating unbounded in-flight sends
+    (the reference serializes through one sendQueue, peer_client.go:450-509).
+    """
+    import grpc.aio
+
+    from gubernator_tpu.core.config import BehaviorConfig
+    from gubernator_tpu.core.types import RateLimitResp
+    from gubernator_tpu.net import grpc_api
+    from gubernator_tpu.proto import peers_pb2
+
+    class StallServicer:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+            self.release = asyncio.Event()
+
+        async def GetPeerRateLimits(self, request, context):
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            try:
+                await self.release.wait()
+            finally:
+                self.active -= 1
+            return peers_pb2.GetPeerRateLimitsResp(
+                rate_limits=[
+                    grpc_api.resp_to_pb(RateLimitResp())
+                    for _ in request.requests
+                ]
+            )
+
+        async def UpdatePeerGlobals(self, request, context):
+            return peers_pb2.UpdatePeerGlobalsResp()
+
+    async def scenario():
+        servicer = StallServicer()
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(
+            (grpc_api.peers_generic_handler(servicer),)
+        )
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+
+        pc = PeerClient(
+            PeerInfo(grpc_address=f"127.0.0.1:{port}"),
+            behavior=BehaviorConfig(
+                batch_wait_s=0.001, batch_limit=4, batch_timeout_s=30.0
+            ),
+        )
+        # Capacity with a stalled peer: 4 in-flight batches x4 + one batch
+        # held by the blocked batcher + 1000 queued = 1020.  Everything
+        # past that must shed immediately.
+        results = {"shed": 0}
+        tasks = []
+
+        async def one(i: int):
+            try:
+                await pc.get_peer_rate_limit(
+                    RateLimitReq(
+                        name="bp", unique_key=f"k{i}", hits=1,
+                        limit=100, duration=60_000,
+                    )
+                )
+            except PeerNotReadyError:
+                results["shed"] += 1
+
+        for i in range(1100):
+            tasks.append(asyncio.ensure_future(one(i)))
+            if i % 50 == 0:
+                await asyncio.sleep(0.005)  # let batches form
+        await asyncio.sleep(0.2)
+        assert servicer.max_active <= 4
+        assert results["shed"] > 0  # queue-full shed kicked in
+        servicer.release.set()
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout=30)
+        assert servicer.max_active <= 4
+        await pc.shutdown()
+        await server.stop(0)
+
+    run(scenario())
+
+
 def test_batching_aggregates_into_one_rpc():
     """Concurrent same-window requests ride one GetPeerRateLimits RPC and
     demux in order (peer_client.go:373-509)."""
