@@ -43,6 +43,26 @@ class PeerNotReadyError(RuntimeError):
     (the reference's PeerErr/IsNotReady, peer_client.go:549-573)."""
 
 
+def provably_unsent(e: BaseException) -> bool:
+    """True when a failed peer call provably never DELIVERED the request —
+    i.e. retrying it cannot double-apply hits on the peer.
+
+    Covers: local shutdown / queue-full (PeerNotReadyError raised before
+    any RPC), and UNAVAILABLE whose detail shows the connection was never
+    established.  A mid-RPC socket reset or timeout is NOT provably unsent
+    (the peer may have applied the batch before the response was lost).
+    """
+    if isinstance(e, PeerNotReadyError):
+        return True
+    if (
+        isinstance(e, grpc.aio.AioRpcError)
+        and e.code() == grpc.StatusCode.UNAVAILABLE
+    ):
+        d = (e.details() or "").lower()
+        return "failed to connect" in d or "connection refused" in d
+    return False
+
+
 class PeerClient:
     """Async client for one peer, with batching."""
 
@@ -157,15 +177,11 @@ class PeerClient:
         try:
             return await self._call_get_peer_rate_limits(reqs)
         except grpc.aio.AioRpcError as e:
+            # NO PeerNotReadyError conversion here: callers of the batch
+            # path (the GLOBAL flush) decide retry-safety via
+            # provably_unsent(), and a blanket UNAVAILABLE conversion would
+            # make a mid-RPC socket reset look retry-safe (double count).
             self._record_error(str(e))
-            if e.code() in (
-                grpc.StatusCode.UNAVAILABLE,
-                grpc.StatusCode.CANCELLED,
-            ):
-                # Same conversion as the single-request path: UNAVAILABLE
-                # here is almost always connect-refused (owner restarting),
-                # i.e. the batch never reached the peer.
-                raise PeerNotReadyError(str(e)) from e
             raise
         finally:
             self._track_inflight(-1)

@@ -162,6 +162,17 @@ class _Pending:
     src_dev: int
 
 
+def zero_delta_grid(n: int, D: int) -> DeltaGrid:
+    """All-zero [n, n, D] delta grid (key_hash=0 rows are inactive)."""
+    z64 = lambda: np.zeros((n, n, D), dtype=np.int64)  # noqa: E731
+    return DeltaGrid(
+        key_hash=z64(), hits=z64(), limit=z64(), duration=z64(),
+        algo=np.zeros((n, n, D), dtype=np.int32), burst=z64(),
+        is_greg=np.zeros((n, n, D), dtype=bool),
+        greg_expire=z64(), greg_duration=z64(),
+    )
+
+
 _ARRIVAL_SHIFT = 44  # disjoint from owner-routing bits (32..) and bucket bits
 
 
@@ -203,6 +214,11 @@ class GlobalEngine:
         self.syncs = 0
         self.sync_keys = 0
         self.dropped = 0
+        # Post-sync hook: called with the synced pending dict (may run on a
+        # device-executor thread).  The service uses it to bridge collective
+        # syncs to the RPC tier — broadcasting owner-authoritative statuses
+        # to cross-NODE peers (global.go:167-250's second loop).
+        self.on_synced = None
 
     # -- serving path ----------------------------------------------------
     def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
@@ -302,6 +318,8 @@ class GlobalEngine:
         with self._lock:
             self.syncs += 1
             self.sync_keys += len(pending)
+        if self.on_synced is not None:
+            self.on_synced(pending)
         return len(pending)
 
     def _build_chunks(self, pending: Dict[str, _Pending], now_dt):
@@ -319,13 +337,7 @@ class GlobalEngine:
         fill: List[np.ndarray] = []  # [n, n] lane counters per chunk
 
         def new_chunk() -> DeltaGrid:
-            z64 = lambda: np.zeros((n, n, D), dtype=np.int64)
-            g = DeltaGrid(
-                key_hash=z64(), hits=z64(), limit=z64(), duration=z64(),
-                algo=np.zeros((n, n, D), dtype=np.int32), burst=z64(),
-                is_greg=np.zeros((n, n, D), dtype=bool),
-                greg_expire=z64(), greg_duration=z64(),
-            )
+            g = zero_delta_grid(n, D)
             chunks.append(g)
             fill.append(np.zeros((n, n), dtype=np.int64))
             return g
@@ -371,6 +383,21 @@ class GlobalEngine:
         if not chunks:
             new_chunk()
         return chunks
+
+    def warmup(self) -> None:
+        """Compile the collective sync executable with an all-zero delta
+        grid (key_hash=0 rows are inactive, so the tables are unchanged) —
+        a first compile inside the serving cadence would stall every lane.
+        """
+        grid = zero_delta_grid(self.n, self.delta_slots)
+        sharded = DeltaGrid(
+            *[jax.device_put(a, self.b._bsharding) for a in grid]
+        )
+        now = np.int64(self.clock.millisecond_now())
+        with self.b._lock, self._lock:
+            self.b.table, self.cache_table = self._sync_step(
+                self.b.table, self.cache_table, sharded, now
+            )
 
     # -- point reads (tests / HealthCheck) -------------------------------
     def get_cached(self, key: str):

@@ -49,7 +49,11 @@ from gubernator_tpu.core.types import (
     UpdatePeerGlobal,
     has_behavior,
 )
-from gubernator_tpu.net.peer_client import PeerClient, PeerNotReadyError
+from gubernator_tpu.net.peer_client import (
+    PeerClient,
+    PeerNotReadyError,
+    provably_unsent,
+)
 from gubernator_tpu.net.replicated_hash import (
     HASH_FUNCTIONS,
     PoolEmptyError,
@@ -141,6 +145,28 @@ class Service:
             )
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
+        # On a mesh backend, GLOBAL keys owned by THIS node serve from the
+        # collective engine's replicated cache and sync over ICI
+        # (all_to_all hits -> owner, all_gather broadcast) instead of the
+        # RPC loops — wired at construction like the reference's
+        # globalManager (gubernator.go:137, global.go:63-64).  The RPC
+        # GlobalManager still handles keys owned by OTHER nodes.
+        self.global_engine = None
+        self._collective_loop: Optional[CollectiveGlobalLoop] = None
+        from gubernator_tpu.parallel.sharded import MeshBackend
+
+        if isinstance(self.backend, MeshBackend):
+            from gubernator_tpu.parallel.global_sync import GlobalEngine
+
+            self.global_engine = GlobalEngine(
+                self.backend,
+                batch_limit=self.cfg.behaviors.global_batch_limit,
+            )
+            self.global_engine.on_synced = self._engine_synced
+            self._collective_loop = CollectiveGlobalLoop(
+                self, self.global_engine
+            )
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._closed = False
         self._started = False
         if self.cfg.loader is not None:
@@ -154,12 +180,19 @@ class Service:
         if self._started:
             return
         self._started = True
+        self._loop = asyncio.get_running_loop()
         self.global_mgr.start()
         self.multi_region_mgr.start()
+        if self._collective_loop is not None:
+            self._collective_loop.start()
         # Warm the jitted device step so the first client request doesn't
         # pay XLA compilation (20-40s cold) inside an RPC deadline.
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(self._dev_executor, self.backend.warmup)
+        if self.global_engine is not None:
+            await loop.run_in_executor(
+                self._dev_executor, self.global_engine.warmup
+            )
 
     # ------------------------------------------------------------------
     # peer management
@@ -285,16 +318,23 @@ class Service:
                 for r in reqs
             ]
 
+        engine_idx: List[int] = []
+
         single_node = self.local_picker.size() == 0
         for i, req in enumerate(reqs):
             # Validation happens in the packer for local requests; forwarded
             # requests are validated by the owner.  Pre-validate here only to
             # avoid forwarding junk.
             key = req.hash_key()
+            is_global = has_behavior(req.behavior, Behavior.GLOBAL)
             if single_node:
-                local_idx.append(i)
-                local_cached.append(False)
-                local_owner_meta.append(None)
+                if is_global and self.global_engine is not None:
+                    self.metrics.getratelimit_counter.labels("global").inc()
+                    engine_idx.append(i)
+                else:
+                    local_idx.append(i)
+                    local_cached.append(False)
+                    local_owner_meta.append(None)
                 continue
             try:
                 peer = self.get_peer(key)
@@ -305,6 +345,12 @@ class Service:
                 )
                 continue
             if peer.info().is_owner:
+                if is_global and self.global_engine is not None:
+                    # This node's mesh owns the key: replicated serving +
+                    # ICI-collective sync instead of the RPC loops.
+                    self.metrics.getratelimit_counter.labels("global").inc()
+                    engine_idx.append(i)
+                    continue
                 self.metrics.getratelimit_counter.labels("local").inc()
                 local_idx.append(i)
                 local_cached.append(False)
@@ -335,6 +381,17 @@ class Service:
                     if local_owner_meta[j] is not None and not resp.error:
                         resp.metadata = {"owner": local_owner_meta[j]}
                     responses[i] = resp
+            if engine_idx:
+                eng_reqs = [reqs[i] for i in engine_idx]
+                loop = asyncio.get_running_loop()
+                eng_resps = await loop.run_in_executor(
+                    self._dev_executor,
+                    lambda: self.global_engine.check(eng_reqs),
+                )
+                for j, i in enumerate(engine_idx):
+                    responses[i] = eng_resps[j]
+                if self._collective_loop is not None:
+                    self._collective_loop.notify()
         finally:
             # Always await in-flight forwards — a local-check failure must
             # not orphan tasks whose hits were already applied on peers.
@@ -521,12 +578,38 @@ class Service:
             h.message = "|".join(errs)
         return h
 
+    def _engine_synced(self, pending) -> None:
+        """Bridge collective syncs to the RPC tier: after the engine applies
+        a window's hits on the auth table, broadcast the (now authoritative)
+        statuses to cross-NODE peers via the RPC GlobalManager.  Runs on a
+        device-executor thread, so hop to the loop for the asyncio queues."""
+        if self.local_picker.size() <= 1:
+            return  # single node — every peer already saw the all_gather
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def queue_all() -> None:
+            for p in pending.values():
+                self.global_mgr.queue_update(p.req)
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            queue_all()
+        else:
+            loop.call_soon_threadsafe(queue_all)
+
     async def close(self) -> None:
         """Flush managers, run the Loader save, shut down peers
         (gubernator.go:159-189)."""
         if self._closed:
             return
         self._closed = True
+        if self._collective_loop is not None:
+            await self._collective_loop.close()
         await self.global_mgr.close()
         await self.multi_region_mgr.close()
         await self._local_batcher.close()
@@ -616,6 +699,62 @@ class LocalBatcher:
             self._task = None
 
 
+class CollectiveGlobalLoop:
+    """Drives GlobalEngine.sync on the global_sync_wait cadence — the
+    collective analog of the reference's runAsyncHits + runBroadcasts
+    timers (global.go:63-64, 96-119): the first queued hit opens a sync
+    window; everything queued within it syncs in one all_to_all/all_gather
+    step.  (The batch-limit trigger lives in GlobalEngine.check itself.)
+    """
+
+    def __init__(self, service: Service, engine) -> None:
+        self.s = service
+        self.engine = engine
+        self.sync_wait_s = service.cfg.behaviors.global_sync_wait_s
+        self._event = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    def notify(self) -> None:
+        """Hits were queued on the engine — open/extend a sync window."""
+        self._event.set()
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await self._event.wait()
+            await asyncio.sleep(self.sync_wait_s)
+            self._event.clear()
+            if self.engine.pending:
+                start = time.monotonic()
+                try:
+                    n = await loop.run_in_executor(
+                        self.s._dev_executor, self.engine.sync
+                    )
+                except Exception as e:  # noqa: BLE001 — keep the cadence
+                    log.error("collective global sync failed: %s", e)
+                    continue
+                if n:
+                    self.s.metrics.async_durations.observe(
+                        time.monotonic() - start
+                    )
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        # Final flush so queued hits survive a graceful shutdown.
+        if self.engine.pending:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                self.s._dev_executor, self.engine.sync
+            )
+
+
 class GlobalManager:
     """Async GLOBAL replication loops (global.go:33-254)."""
 
@@ -698,26 +837,28 @@ class GlobalManager:
                         timeout=self.timeout_s,
                     )
                     self.async_sends += 1
-                except PeerNotReadyError as e:
-                    # Shutdown / queue-full provably precede any send, so
-                    # re-queueing cannot double count; a transiently
-                    # unreachable owner keeps the window's hits
-                    # (aggregation bounds the backlog by unique keys).
-                    log.warning(
-                        "re-queueing global hits for '%s': %s",
-                        peer.info().grpc_address, e,
-                    )
-                    for r in chunk:
-                        self.queue_hit(r)
                 except Exception as e:  # noqa: BLE001
-                    # Timeout or mid-RPC failure: the owner MAY have applied
-                    # the batch already — re-sending would double count.
-                    # Drop, like the reference (global.go:152-162); the next
-                    # live hit re-syncs the key.
-                    log.error(
-                        "dropping global hits for '%s': %s",
-                        peer.info().grpc_address, e,
-                    )
+                    if provably_unsent(e):
+                        # Shutdown / queue-full / connect-refused provably
+                        # precede any delivery, so re-queueing cannot double
+                        # count; a transiently unreachable owner keeps the
+                        # window's hits (aggregation bounds the backlog by
+                        # unique keys).
+                        log.warning(
+                            "re-queueing global hits for '%s': %s",
+                            peer.info().grpc_address, e,
+                        )
+                        for r in chunk:
+                            self.queue_hit(r)
+                    else:
+                        # Timeout or mid-RPC failure: the owner MAY have
+                        # applied the batch already — re-sending would
+                        # double count.  Drop, like the reference
+                        # (global.go:152-162); the next live hit re-syncs.
+                        log.error(
+                            "dropping global hits for '%s': %s",
+                            peer.info().grpc_address, e,
+                        )
 
         # Fan out per peer — one slow peer must not delay the others.
         await asyncio.gather(

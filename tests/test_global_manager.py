@@ -21,11 +21,34 @@ def run(coro):
     return asyncio.new_event_loop().run_until_complete(coro)
 
 
+import grpc
+import grpc.aio
+
+
+class FakeRpcError(grpc.aio.AioRpcError):
+    """AioRpcError stand-in with a chosen code + details (the real class
+    needs live call internals to construct)."""
+
+    def __init__(self, code, details: str):
+        self._fake_code, self._fake_details = code, details
+
+    def code(self):
+        return self._fake_code
+
+    def details(self):
+        return self._fake_details
+
+    def __str__(self):
+        return f"FakeRpcError({self._fake_code}, {self._fake_details})"
+
+
 class FakePeer:
     """Owner stand-in: applies the batch, then optionally stalls or fails."""
 
     def __init__(self, mode: str, stall_s: float = 0.0):
-        self.mode = mode  # "ok" | "stall_after_apply" | "not_ready"
+        # "ok" | "stall_after_apply" | "not_ready" | "connect_refused"
+        # | "socket_reset"
+        self.mode = mode
         self.stall_s = stall_s
         self.applied = []  # (key, hits) per received request
 
@@ -36,8 +59,18 @@ class FakePeer:
         if self.mode == "not_ready":
             # Shed BEFORE any send — the queue-full / shutdown path.
             raise PeerNotReadyError("queue full")
+        if self.mode == "connect_refused":
+            # Connection never established — provably unsent.
+            raise FakeRpcError(
+                grpc.StatusCode.UNAVAILABLE,
+                "failed to connect to all addresses",
+            )
         for r in reqs:
             self.applied.append((r.hash_key(), r.hits))
+        if self.mode == "socket_reset":
+            # Delivered + applied, then the connection died before the
+            # response: also UNAVAILABLE, but NOT retry-safe.
+            raise FakeRpcError(grpc.StatusCode.UNAVAILABLE, "Socket closed")
         if self.mode == "stall_after_apply":
             # The RPC was delivered and applied, but the response is late:
             # the caller's wait_for times out.
@@ -95,6 +128,36 @@ def test_not_ready_requeues_hits():
         await mgr._send_hits(hits)
         assert peer.applied == []
         assert "g_b" in mgr._hits and mgr._hits["g_b"].hits == 2
+
+    run(scenario())
+
+
+def test_connect_refused_requeues_hits():
+    """UNAVAILABLE with a connection-establishment detail is provably
+    unsent — the window's hits survive an owner restart."""
+    async def scenario():
+        peer = FakePeer("connect_refused")
+        mgr = _manager(peer)
+        mgr.queue_hit(_req("d", hits=7))
+        hits, mgr._hits = dict(mgr._hits), {}
+        await mgr._send_hits(hits)
+        assert peer.applied == []
+        assert mgr._hits["g_d"].hits == 7
+
+    run(scenario())
+
+
+def test_mid_rpc_reset_drops_hits():
+    """UNAVAILABLE from a mid-RPC socket reset is NOT retry-safe: the owner
+    already applied the batch, so the hits are dropped, not re-queued."""
+    async def scenario():
+        peer = FakePeer("socket_reset")
+        mgr = _manager(peer)
+        mgr.queue_hit(_req("e", hits=3))
+        hits, mgr._hits = dict(mgr._hits), {}
+        await mgr._send_hits(hits)
+        assert peer.applied == [("g_e", 3)]  # applied exactly once
+        assert mgr._hits == {}
 
     run(scenario())
 

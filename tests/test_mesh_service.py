@@ -4,9 +4,10 @@ from __future__ import annotations
 
 import asyncio
 
-from gubernator_tpu.core.config import Config, DeviceConfig
+from gubernator_tpu.core.config import BehaviorConfig, Config, DeviceConfig
 from gubernator_tpu.core.types import (
     Algorithm,
+    Behavior,
     RateLimitReq,
     Status,
     UpdatePeerGlobal,
@@ -46,6 +47,125 @@ def test_service_on_mesh_backend():
                           duration=1000)]
         )
         assert bad[0].error == "field 'namespace' cannot be empty"
+        await svc.close()
+
+    run(scenario())
+
+
+def test_global_on_mesh_routes_through_collective_engine():
+    """GLOBAL hits entering different shards converge on the auth table
+    through the ICI-collective engine — NOT through the RPC GlobalManager
+    or update_peer_globals (VERDICT r1 #1; reference wiring
+    global.go:63-64)."""
+    async def scenario():
+        svc = Service(Config(
+            device=MESH_DEV,
+            behaviors=BehaviorConfig(global_sync_wait_s=0.01),
+        ))
+        await svc.start()
+        assert svc.global_engine is not None
+
+        keys = [f"gk{i}" for i in range(24)]
+        reqs = [
+            RateLimitReq(
+                name="g", unique_key=k, hits=1, limit=10,
+                duration=60_000, behavior=Behavior.GLOBAL,
+            )
+            for k in keys
+        ]
+        r1 = await svc.get_rate_limits(reqs)
+        assert all(x.error == "" for x in r1)
+        assert all(x.remaining == 9 for x in r1)
+        # Keys arrive on multiple serving devices (different shards).
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.parallel.global_sync import arrival_dev
+
+        devs = {arrival_dev(key_hash64(f"g_{k}"), 8) for k in keys}
+        assert len(devs) >= 4
+
+        # Hits queued on the ENGINE, not the RPC manager.
+        assert len(svc.global_engine.pending) == 24
+        assert svc.global_mgr._hits == {}
+
+        # The sync cadence flushes through the collective step.
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while svc.global_engine.syncs < 1:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert not svc.global_engine.pending
+        assert svc.global_mgr.async_sends == 0  # no RPC tier involved
+        assert svc.global_mgr.broadcasts == 0
+
+        # Owner-authoritative state landed on the sharded auth table...
+        for k in keys:
+            item = svc.backend.get_cache_item(f"g_{k}")
+            assert item is not None and item.remaining == 9, k
+        # ...and the all_gather broadcast serves subsequent reads.
+        r2 = await svc.get_rate_limits([
+            RateLimitReq(
+                name="g", unique_key=k, hits=0, limit=10,
+                duration=60_000, behavior=Behavior.GLOBAL,
+            )
+            for k in keys
+        ])
+        assert all(x.remaining == 9 for x in r2)
+        await svc.close()
+
+    run(scenario())
+
+
+def test_engine_sync_bridges_to_rpc_broadcast():
+    """With cross-node peers present, a collective sync hands the synced
+    statuses to the RPC GlobalManager for UpdatePeerGlobals broadcast (the
+    cross-NODE half of global.go:167-250)."""
+    async def scenario():
+        from gubernator_tpu.core.types import PeerInfo
+
+        svc = Service(Config(
+            device=MESH_DEV,
+            behaviors=BehaviorConfig(global_sync_wait_s=0.01),
+        ))
+        await svc.start()
+        # Two peers: us + one remote (fake address, never reachable — we
+        # assert the broadcast ATTEMPT, not delivery).
+        await svc.set_peers([
+            PeerInfo(grpc_address="127.0.0.1:1", is_owner=True),
+            PeerInfo(grpc_address="127.0.0.1:2"),
+        ])
+        req = RateLimitReq(
+            name="g", unique_key="bridge", hits=2, limit=10,
+            duration=60_000, behavior=Behavior.GLOBAL,
+        )
+        if not svc.get_peer(req.hash_key()).info().is_owner:
+            # Key hashed to the remote peer — flip ownership flags so WE
+            # own it and the collective engine takes the request.
+            await svc.set_peers([
+                PeerInfo(grpc_address="127.0.0.1:1"),
+                PeerInfo(grpc_address="127.0.0.1:2", is_owner=True),
+            ])
+        r = (await svc.get_rate_limits([req]))[0]
+        assert r.error == ""
+        assert len(svc.global_engine.pending) == 1
+        assert svc.global_mgr._hits == {}  # RPC hit tier not involved
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 10.0
+
+        def bridged() -> bool:
+            # Either the update is still queued, or the broadcast loop
+            # already tried pushing to the unreachable remote peer and
+            # recorded the failure in its error window.
+            if "g_bridge" in svc.global_mgr._updates:
+                return True
+            remotes = [
+                p for p in svc.peer_list() if not p.info().is_owner
+            ]
+            return any(p.last_errors() for p in remotes)
+
+        while not bridged():
+            assert loop.time() < deadline
+            await asyncio.sleep(0.02)
+        assert svc.global_engine.syncs >= 1
         await svc.close()
 
     run(scenario())
