@@ -46,11 +46,13 @@ from gubernator_tpu.ops.step import (
     store_cached_rows_impl,
 )
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of_hash
-from gubernator_tpu.parallel.sharded import MeshBackend, _shard_map
-from gubernator_tpu.runtime.backend import (
-    resp_rounds_to_host,
-    unmarshal_responses,
+from gubernator_tpu.parallel.sharded import (
+    MeshBackend,
+    _shard_map,
+    pack_grid_batch,
+    packed_grid_rounds_to_host,
 )
+from gubernator_tpu.runtime.backend import unmarshal_responses
 
 
 class DeltaGrid(NamedTuple):
@@ -206,7 +208,9 @@ class GlobalEngine:
         self.cache_table: SlotTable = jax.device_put(
             init_table(backend.cfg.num_slots), backend._tsharding
         )
-        self._ingest = backend._step  # same sharded step, run on cache table
+        # Same packed sharded step as the backend hot path, run on the
+        # cache table (single-transfer in and out).
+        self._ingest = backend._step_packed
         self._sync_step = make_global_sync_step(backend.mesh, backend.cfg.ways)
         self._lock = threading.Lock()  # cache_table + pending + metrics
         self.pending: Dict[str, _Pending] = {}
@@ -263,8 +267,8 @@ class GlobalEngine:
         round_resps = []
         with self._lock:
             for db in packed.rounds:
-                batch = DeviceBatchJ(
-                    *[jax.device_put(a, self.b._bsharding) for a in db]
+                batch = jax.device_put(
+                    pack_grid_batch(db), self.b._psharding
                 )
                 self.cache_table, resp = self._ingest(
                     self.cache_table, batch, now
@@ -289,7 +293,7 @@ class GlobalEngine:
 
         agg_out, tally = unmarshal_responses(
             len(agg_reqs), packed.errors, packed.positions,
-            resp_rounds_to_host(round_resps),
+            packed_grid_rounds_to_host(round_resps),
         )
         self.b._add_tally(tally)
         if want_sync:

@@ -35,11 +35,10 @@ from gubernator_tpu.core.hashing import key_hash64
 from gubernator_tpu.core.types import CacheItem, RateLimitReq, RateLimitResp
 from gubernator_tpu.ops.batch import PackedGrid, pack_requests_grid
 from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
-from gubernator_tpu.ops.step import DeviceBatchJ, Resp, apply_batch_impl
+from gubernator_tpu.ops.step import DeviceBatchJ, apply_batch_packed_impl
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_of_hash
 from gubernator_tpu.runtime.backend import (
     probe_bucket,
-    resp_rounds_to_host,
     unmarshal_responses,
 )
 
@@ -67,22 +66,74 @@ def pack_requests_sharded(
     )
 
 
-def make_sharded_step(mesh, ways: int):
-    """Build the jitted multi-device step: table'[n·S], resp[n,B] =
-    step(table[n·S], batch[n,B], now)."""
+# -- packed single-transfer hot path ------------------------------------
+# A per-field path would cost 12 sharded host->device puts and 6
+# device->host reads per round; with a per-transfer host link latency
+# (remote-device tunnels) transfers dominate E2E, which is why the
+# single-device backend got apply_batch_packed (ops/step.py:542-568).
+# Here the whole DeviceBatch travels as ONE int64[12, n, B] array and the
+# response returns as ONE int64[n, 6, B] array.
 
-    def _local(table: SlotTable, batch: DeviceBatchJ, now):
-        b = DeviceBatchJ(*[a[0] for a in batch])
-        t2, r = apply_batch_impl(table, b, now, ways=ways)
-        return t2, Resp(*[a[None] for a in r])
+
+def pack_grid_batch(db) -> np.ndarray:
+    """Stack a [n, B] DeviceBatch into one int64[12, n, B] host array."""
+    arrs = [np.asarray(a) for a in db]
+    out = np.empty((len(arrs),) + arrs[0].shape, dtype=np.int64)
+    for i, a in enumerate(arrs):
+        out[i] = a
+    return out
+
+
+def unpack_grid_batch(q) -> DeviceBatchJ:
+    """Device-side inverse of pack_grid_batch for one shard block [12, B]."""
+    import jax.numpy as jnp
+
+    return DeviceBatchJ(
+        key_hash=q[0], hits=q[1], limit=q[2], duration=q[3],
+        algo=q[4].astype(jnp.int32), burst=q[5],
+        reset_remaining=q[6].astype(bool), is_greg=q[7].astype(bool),
+        greg_expire=q[8], greg_duration=q[9],
+        active=q[10].astype(bool), use_cached=q[11].astype(bool),
+    )
+
+
+def make_sharded_step_packed(mesh, ways: int):
+    """Jitted multi-device step over packed transfers:
+    table'[n·S], resp[n, 6, B] = step(table[n·S], batch[12, n, B], now).
+
+    Response row order is apply_batch_packed's: status, limit, remaining,
+    reset_time, persisted, found (one shared packer, ops/step.py:542-568).
+    """
+
+    def _local(table: SlotTable, packed, now):
+        b = unpack_grid_batch(packed[:, 0])
+        t2, resp = apply_batch_packed_impl(table, b, now, ways=ways)
+        return t2, resp[None]
 
     sharded = _shard_map(
         _local,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
+    """Host view of packed [n, 6, B] responses — one transfer per round.
+    Field arrays are [n, B], so (shard, lane) positions index directly."""
+    out = []
+    for p in round_resps:
+        a = np.asarray(p)
+        out.append({
+            "status": a[:, 0],
+            "limit": a[:, 1],
+            "remaining": a[:, 2],
+            "reset_time": a[:, 3],
+            "persisted": a[:, 4],
+            "found": a[:, 5],
+        })
+    return out
 
 
 def make_sharded_cached_store(mesh, ways: int):
@@ -139,7 +190,9 @@ class MeshBackend:
         self.table: SlotTable = jax.device_put(
             init_table(cfg.num_slots), self._tsharding
         )
-        self._step = make_sharded_step(self.mesh, cfg.ways)
+        self._step_packed = make_sharded_step_packed(self.mesh, cfg.ways)
+        # Batch input sharding: [12, n, B] split on the shard axis (dim 1).
+        self._psharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._cached_store = make_sharded_cached_store(self.mesh, cfg.ways)
         self.checks = 0
         self.over_limit = 0
@@ -177,14 +230,13 @@ class MeshBackend:
         round_resps = []
         with self._lock:
             for db in packed.rounds:
-                batch = DeviceBatchJ(
-                    *[jax.device_put(a, self._bsharding) for a in db]
-                )
-                self.table, resp = self._step(self.table, batch, now)
+                # ONE sharded put for the whole batch, ONE packed readback.
+                batch = jax.device_put(pack_grid_batch(db), self._psharding)
+                self.table, resp = self._step_packed(self.table, batch, now)
                 round_resps.append(resp)
         out, tally = unmarshal_responses(
             len(reqs), packed.errors, packed.positions,
-            resp_rounds_to_host(round_resps),
+            packed_grid_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
         return out

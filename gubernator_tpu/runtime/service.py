@@ -331,6 +331,10 @@ class Service:
                 if is_global and self.global_engine is not None:
                     self.metrics.getratelimit_counter.labels("global").inc()
                     engine_idx.append(i)
+                    if has_behavior(req.behavior, Behavior.MULTI_REGION):
+                        # The engine path bypasses _check_local's owner-side
+                        # queueing — keep cross-region replication alive.
+                        self.multi_region_mgr.queue_hits(req)
                 else:
                     local_idx.append(i)
                     local_cached.append(False)
@@ -350,6 +354,8 @@ class Service:
                     # ICI-collective sync instead of the RPC loops.
                     self.metrics.getratelimit_counter.labels("global").inc()
                     engine_idx.append(i)
+                    if has_behavior(req.behavior, Behavior.MULTI_REGION):
+                        self.multi_region_mgr.queue_hits(req)
                     continue
                 self.metrics.getratelimit_counter.labels("local").inc()
                 local_idx.append(i)
@@ -699,6 +705,24 @@ class LocalBatcher:
             self._task = None
 
 
+async def window_flush_loop(event, sync_wait_s, take, flush) -> None:
+    """The shared batching heartbeat (interval.go:29-72's one-shot ticker):
+    the first queued item sets `event`, opening a `sync_wait_s` window;
+    when it closes, `take()`'s batch (if any) goes to `flush`.  A flush
+    failure is logged and the cadence survives (the flushers do their own
+    per-chunk error handling; this guard is the backstop)."""
+    while True:
+        await event.wait()
+        await asyncio.sleep(sync_wait_s)
+        event.clear()
+        batch = take()
+        if batch:
+            try:
+                await flush(batch)
+            except Exception as e:  # noqa: BLE001 — keep the cadence
+                log.error("window flush failed: %s", e)
+
+
 class CollectiveGlobalLoop:
     """Drives GlobalEngine.sync on the global_sync_wait cadence — the
     collective analog of the reference's runAsyncHits + runBroadcasts
@@ -716,31 +740,25 @@ class CollectiveGlobalLoop:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._run())
+            self._task = asyncio.ensure_future(
+                window_flush_loop(
+                    self._event, self.sync_wait_s,
+                    lambda: self.engine.pending, self._flush,
+                )
+            )
 
     def notify(self) -> None:
         """Hits were queued on the engine — open/extend a sync window."""
         self._event.set()
 
-    async def _run(self) -> None:
+    async def _flush(self, _pending) -> None:
         loop = asyncio.get_running_loop()
-        while True:
-            await self._event.wait()
-            await asyncio.sleep(self.sync_wait_s)
-            self._event.clear()
-            if self.engine.pending:
-                start = time.monotonic()
-                try:
-                    n = await loop.run_in_executor(
-                        self.s._dev_executor, self.engine.sync
-                    )
-                except Exception as e:  # noqa: BLE001 — keep the cadence
-                    log.error("collective global sync failed: %s", e)
-                    continue
-                if n:
-                    self.s.metrics.async_durations.observe(
-                        time.monotonic() - start
-                    )
+        start = time.monotonic()
+        n = await loop.run_in_executor(
+            self.s._dev_executor, self.engine.sync
+        )
+        if n:
+            self.s.metrics.async_durations.observe(time.monotonic() - start)
 
     async def close(self) -> None:
         if self._task is not None:
@@ -801,17 +819,22 @@ class GlobalManager:
         self._updates[r.hash_key()] = r
         self._updates_event.set()
 
+    def _take_hits(self) -> Dict[str, RateLimitReq]:
+        hits, self._hits = self._hits, {}
+        return hits
+
+    def _take_updates(self) -> Dict[str, RateLimitReq]:
+        updates, self._updates = self._updates, {}
+        return updates
+
     async def _run_async_hits(self) -> None:
         # The first queued hit opens a sync_wait window; everything queued
         # within it flushes together (interval semantics, global.go:96-119),
         # split into batch_limit-sized RPCs by _send_hits.
-        while True:
-            await self._hits_event.wait()
-            await asyncio.sleep(self.sync_wait_s)
-            self._hits_event.clear()
-            hits, self._hits = self._hits, {}
-            if hits:
-                await self._send_hits(hits)
+        await window_flush_loop(
+            self._hits_event, self.sync_wait_s,
+            self._take_hits, self._send_hits,
+        )
 
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
         """Group aggregated hits by owning peer and flush
@@ -867,13 +890,10 @@ class GlobalManager:
         self.s.metrics.async_durations.observe(time.monotonic() - start)
 
     async def _run_broadcasts(self) -> None:
-        while True:
-            await self._updates_event.wait()
-            await asyncio.sleep(self.sync_wait_s)
-            self._updates_event.clear()
-            updates, self._updates = self._updates, {}
-            if updates:
-                await self._broadcast_peers(updates)
+        await window_flush_loop(
+            self._updates_event, self.sync_wait_s,
+            self._take_updates, self._broadcast_peers,
+        )
 
     async def _broadcast_peers(
         self, updates: Dict[str, RateLimitReq]
@@ -953,6 +973,17 @@ class GlobalManager:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        # Drain-on-close: flush queued hits and broadcast queued updates
+        # (best effort) — a graceful multi-node shutdown must not strand the
+        # last window's statuses, especially those the collective engine's
+        # final sync just queued for cross-node broadcast.
+        hits = self._take_hits()
+        if hits:
+            await self._send_hits(hits)
+        updates = self._take_updates()
+        if updates:
+            await self._broadcast_peers(updates)
 
 
 class MultiRegionManager:
@@ -993,14 +1024,14 @@ class MultiRegionManager:
             self._hits[key] = dc_replace(r)
         self._event.set()
 
+    def _take_hits(self) -> Dict[str, RateLimitReq]:
+        hits, self._hits = self._hits, {}
+        return hits
+
     async def _run(self) -> None:
-        while True:
-            await self._event.wait()
-            await asyncio.sleep(self.sync_wait_s)
-            self._event.clear()
-            hits, self._hits = self._hits, {}
-            if hits:
-                await self._send_hits(hits)
+        await window_flush_loop(
+            self._event, self.sync_wait_s, self._take_hits, self._send_hits
+        )
 
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
         from dataclasses import replace as dc_replace
