@@ -38,6 +38,8 @@ from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
 from gubernator_tpu.ops.step import DeviceBatchJ, apply_batch_packed_impl
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shard_of_hash
 from gubernator_tpu.runtime.backend import (
+    PersistenceHost,
+    _row_to_item,
     probe_bucket,
     unmarshal_responses,
 )
@@ -136,14 +138,17 @@ def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
     return out
 
 
-def make_sharded_cached_store(mesh, ways: int):
-    """Sharded GLOBAL broadcast receive: each shard upserts its routed
-    KIND_CACHED_RESP rows (gubernator.go:464-479 over the mesh)."""
-    from gubernator_tpu.ops.step import CachedRows, store_cached_rows_impl
+def make_sharded_row_op(mesh, ways: int, impl, row_type):
+    """Shared factory for row-upsert collectiveless steps: each shard
+    applies `impl` to its routed [B] block of `row_type` rows.  Instances:
+    - load_rows_impl/BucketRows — Loader restore / Store.get seeding
+      (workers.go:340-426 over the mesh);
+    - store_cached_rows_impl/CachedRows — GLOBAL broadcast receive
+      (gubernator.go:464-479 over the mesh)."""
 
-    def _local(table: SlotTable, rows: CachedRows, now):
-        r = CachedRows(*[a[0] for a in rows])
-        return store_cached_rows_impl(table, r, now, ways=ways)
+    def _local(table: SlotTable, rows, now):
+        r = row_type(*[a[0] for a in rows])
+        return impl(table, r, now, ways=ways)
 
     sharded = _shard_map(
         _local,
@@ -154,7 +159,39 @@ def make_sharded_cached_store(mesh, ways: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-class MeshBackend:
+def make_sharded_probe(mesh, ways: int):
+    """Sharded read-only lookup: (found[n,B], local_slot[n,B]) for a
+    shard-routed hash grid — one jitted call per chunk instead of per-key
+    host probes (the mesh analog of ops/step.probe_batch)."""
+    from gubernator_tpu.ops.step import probe_batch_impl
+
+    def _local(table: SlotTable, h, now):
+        f, s = probe_batch_impl(table, h[0], now, ways=ways)
+        return f[None], s[None]
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded)
+
+
+def drain_to_grids(per_shard: List[list], B: int, make_grid, fill_lane):
+    """Drain per-shard row lists into consecutive [n, B] grids (overflow
+    chunks into extra grids).  `fill_lane(grid, shard, lane, row)` writes
+    one row; yields each full grid."""
+    while any(per_shard):
+        grid = make_grid()
+        for s in range(len(per_shard)):
+            take, per_shard[s] = per_shard[s][:B], per_shard[s][B:]
+            for lane, row in enumerate(take):
+                fill_lane(grid, s, lane, row)
+        yield grid
+
+
+class MeshBackend(PersistenceHost):
     """Drop-in peer of runtime.backend.DeviceBackend over a device mesh."""
 
     def __init__(
@@ -166,13 +203,11 @@ class MeshBackend:
         store=None,
         track_keys: bool = False,
     ) -> None:
-        if store is not None or track_keys:
-            raise NotImplementedError(
-                "the Store/Loader SPI is single-device for now; use "
-                "TableCheckpointer for mesh persistence"
-            )
         self.metrics = metrics
-        self.store = None
+        self.store = store
+        self._keymap: Optional[Dict[int, str]] = (
+            {} if (store is not None or track_keys) else None
+        )
         if cfg.num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         self.cfg = cfg
@@ -190,10 +225,23 @@ class MeshBackend:
         self.table: SlotTable = jax.device_put(
             init_table(cfg.num_slots), self._tsharding
         )
+        from gubernator_tpu.ops.step import (
+            BucketRows,
+            CachedRows,
+            load_rows_impl,
+            store_cached_rows_impl,
+        )
+
         self._step_packed = make_sharded_step_packed(self.mesh, cfg.ways)
         # Batch input sharding: [12, n, B] split on the shard axis (dim 1).
         self._psharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
-        self._cached_store = make_sharded_cached_store(self.mesh, cfg.ways)
+        self._cached_store = make_sharded_row_op(
+            self.mesh, cfg.ways, store_cached_rows_impl, CachedRows
+        )
+        self._load_rows_sharded = make_sharded_row_op(
+            self.mesh, cfg.ways, load_rows_impl, BucketRows
+        )
+        self._probe_sharded = make_sharded_probe(self.mesh, cfg.ways)
         self.checks = 0
         self.over_limit = 0
         self.not_persisted = 0
@@ -225,10 +273,19 @@ class MeshBackend:
             reqs, self.cfg.batch_size, self.cfg.num_shards, self.clock,
             use_cached,
         )
-        now = np.int64(self.clock.millisecond_now())
+        now_ms = self.clock.millisecond_now()
+        now = np.int64(now_ms)
+        if self._keymap is not None:
+            for i, r in enumerate(reqs):
+                if i not in packed.errors:
+                    k = r.hash_key()
+                    self._keymap[key_hash64(k)] = k
+            self._maybe_prune_keymap()
 
         round_resps = []
         with self._lock:
+            if self.store is not None:
+                self._seed_from_store(reqs, packed, now_ms)
             for db in packed.rounds:
                 # ONE sharded put for the whole batch, ONE packed readback.
                 batch = jax.device_put(pack_grid_batch(db), self._psharding)
@@ -239,69 +296,98 @@ class MeshBackend:
             packed_grid_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
+        if self.store is not None:
+            self._write_through(reqs, packed, out, use_cached)
         return out
 
     def warmup(self) -> None:
-        """Compile the sharded step executables before serving."""
+        """Compile the sharded executables with a synthetic batch that
+        BYPASSES the Store/keymap hooks and the tallies — a check() here
+        would leak '__warmup__' keys into an attached store (the same
+        bypass DeviceBackend.warmup applies)."""
         reqs = [
             RateLimitReq(name="__warmup__", unique_key=f"w{s}", hits=0,
                          limit=1, duration=1)
             for s in range(self.cfg.num_shards)
         ]
-        r = self.check(reqs)
-        del r
-        self.apply_cached_rows([])
+        packed = pack_requests_sharded(
+            reqs, self.cfg.batch_size, self.cfg.num_shards, self.clock
+        )
+        now = np.int64(self.clock.millisecond_now())
+        with self._lock:
+            for db in packed.rounds:
+                batch = jax.device_put(pack_grid_batch(db), self._psharding)
+                self.table, resp = self._step_packed(self.table, batch, now)
+            # Probe + broadcast-receive executables (store seeding,
+            # UpdatePeerGlobals paths) — zero grids, no side effects.
+            from gubernator_tpu.ops.step import CachedRows
+
+            zeros = jax.device_put(
+                np.zeros(
+                    (self.cfg.num_shards, self.cfg.batch_size),
+                    dtype=np.int64,
+                ),
+                self._bsharding,
+            )
+            self._probe_sharded(self.table, zeros, now)
+            self.table = self._cached_store(
+                self.table,
+                CachedRows(*[
+                    jax.device_put(a, self._bsharding)
+                    for a in self._zero_cached_grid()
+                ]),
+                now,
+            )
+        jax.block_until_ready(resp)
 
     # -- GLOBAL broadcast receive ----------------------------------------
-    def apply_cached_rows(self, rows: Sequence[tuple]) -> None:
-        """Upsert owner-broadcast statuses, routed to their shards: rows of
-        (hash_key_str, algorithm, limit, remaining, status, reset_time)."""
+    def _zero_cached_grid(self):
         from gubernator_tpu.ops.step import CachedRows
 
         n, B = self.cfg.num_shards, self.cfg.batch_size
+        return CachedRows(
+            key_hash=np.zeros((n, B), dtype=np.int64),
+            algo=np.zeros((n, B), dtype=np.int32),
+            limit=np.zeros((n, B), dtype=np.int64),
+            remaining=np.zeros((n, B), dtype=np.int64),
+            status=np.zeros((n, B), dtype=np.int32),
+            reset_time=np.zeros((n, B), dtype=np.int64),
+        )
+
+    def apply_cached_rows(self, rows: Sequence[tuple]) -> None:
+        """Upsert owner-broadcast statuses, routed to their shards: rows of
+        (hash_key_str, algorithm, limit, remaining, status, reset_time)."""
+        n, B = self.cfg.num_shards, self.cfg.batch_size
         now = np.int64(self.clock.millisecond_now())
-        # Route rows to shards; chunk any shard overflow into extra passes.
-        per_shard: List[List[tuple]] = [[] for _ in range(n)]
+        if self._keymap is not None:
+            for key, *_ in rows:
+                self._keymap[key_hash64(key)] = key
+        per_shard: List[list] = [[] for _ in range(n)]
         for row in rows:
             h = key_hash64(row[0])
             per_shard[int(shard_of_hash(h, n))].append(row)
-        while True:
-            grid = CachedRows(
-                key_hash=np.zeros((n, B), dtype=np.int64),
-                algo=np.zeros((n, B), dtype=np.int32),
-                limit=np.zeros((n, B), dtype=np.int64),
-                remaining=np.zeros((n, B), dtype=np.int64),
-                status=np.zeros((n, B), dtype=np.int32),
-                reset_time=np.zeros((n, B), dtype=np.int64),
+
+        def fill(grid, s, lane, row):
+            key, algo, limit, rem, status, reset = row
+            grid.key_hash[s, lane] = np.int64(
+                np.uint64(key_hash64(key)).view(np.int64)
             )
-            any_filled = False
-            for s in range(n):
-                take, per_shard[s] = per_shard[s][:B], per_shard[s][B:]
-                for lane, (key, algo, limit, rem, status, reset) in (
-                    enumerate(take)
-                ):
-                    grid.key_hash[s, lane] = np.int64(
-                        np.uint64(key_hash64(key)).view(np.int64)
-                    )
-                    grid.algo[s, lane] = algo
-                    grid.limit[s, lane] = limit
-                    grid.remaining[s, lane] = rem
-                    grid.status[s, lane] = status
-                    grid.reset_time[s, lane] = reset
-                    any_filled = True
+            grid.algo[s, lane] = algo
+            grid.limit[s, lane] = limit
+            grid.remaining[s, lane] = rem
+            grid.status[s, lane] = status
+            grid.reset_time[s, lane] = reset
+
+        for grid in drain_to_grids(per_shard, B, self._zero_cached_grid,
+                                   fill):
             with self._lock:
                 self.table = self._cached_store(
                     self.table,
-                    CachedRows(
-                        *[
-                            jax.device_put(a, self._bsharding)
-                            for a in grid
-                        ]
-                    ),
+                    type(grid)(*[
+                        jax.device_put(a, self._bsharding) for a in grid
+                    ]),
                     now,
                 )
-            if not any_filled or not any(per_shard):
-                break
 
     # -- point reads / persistence ---------------------------------------
     def bucket_offset(self, key: str, shard: int) -> int:
@@ -317,9 +403,146 @@ class MeshBackend:
         with self._lock:
             return probe_bucket(self.table, lo, self.cfg.ways, key, now)
 
+    def _probe_nolock(
+        self, key: str, now: int, include_cached: bool
+    ) -> Optional[CacheItem]:
+        shard = int(shard_of_hash(key_hash64(key), self.cfg.num_shards))
+        lo = self.bucket_offset(key, shard)
+        return probe_bucket(
+            self.table, lo, self.cfg.ways, key, now,
+            include_cached=include_cached,
+        )
+
+    # -- persistence device hooks (PersistenceHost) ----------------------
+    def _probe_grid(self, keys: Sequence[str], hashes, now: int):
+        """Shard-routed batched probes: (found, global_slot) per key, in
+        key order, one jitted probe per chunk (lock held)."""
+        n, B = self.cfg.num_shards, self.cfg.batch_size
+        per_shard: List[list] = [[] for _ in range(n)]
+        for j, h in enumerate(hashes):
+            per_shard[int(shard_of_hash(h, n))].append((j, h))
+
+        found = np.zeros(len(keys), dtype=bool)
+        gslot = np.zeros(len(keys), dtype=np.int64)
+
+        def make_grid():
+            return [
+                np.zeros((n, B), dtype=np.int64),  # hashes
+                np.full((n, B), -1, dtype=np.int64),  # original index
+            ]
+
+        def fill(grid, s, lane, row):
+            j, h = row
+            grid[0][s, lane] = np.int64(np.uint64(h).view(np.int64))
+            grid[1][s, lane] = j
+
+        for hv, jv in drain_to_grids(per_shard, B, make_grid, fill):
+            f, slot = self._probe_sharded(
+                self.table,
+                jax.device_put(hv, self._bsharding),
+                np.int64(now),
+            )
+            f, slot = np.asarray(f), np.asarray(slot)
+            for s in range(n):
+                sel = jv[s] >= 0
+                js = jv[s][sel]
+                found[js] = f[s][sel]
+                gslot[js] = s * self.local_slots + slot[s][sel]
+        return found, gslot
+
+    def _found_mask(self, keys, hashes, now: int) -> np.ndarray:
+        found, _ = self._probe_grid(keys, hashes, now)
+        return found
+
+    def _bulk_upsert(
+        self, rows: List[dict], hashes: List[int], now: int
+    ) -> None:
+        """Route row dicts to their shards and upsert via the sharded
+        load_rows step (lock held)."""
+        from gubernator_tpu.ops.step import BucketRows
+
+        n, B = self.cfg.num_shards, self.cfg.batch_size
+        per_shard: List[list] = [[] for _ in range(n)]
+        for row, h in zip(rows, hashes):
+            per_shard[int(shard_of_hash(h, n))].append((h, row))
+        fields = (
+            "algo", "limit", "duration", "remaining", "remaining_f",
+            "t0", "status", "burst", "expire_at",
+        )
+
+        def make_grid():
+            return BucketRows(
+                key_hash=np.zeros((n, B), dtype=np.int64),
+                **{
+                    f: np.zeros(
+                        (n, B),
+                        dtype=np.float64 if f == "remaining_f" else (
+                            np.int32 if f in ("algo", "status") else np.int64
+                        ),
+                    )
+                    for f in fields
+                },
+            )
+
+        def fill(grid, s, lane, row):
+            h, rd = row
+            grid.key_hash[s, lane] = np.int64(np.uint64(h).view(np.int64))
+            for f in fields:
+                getattr(grid, f)[s, lane] = rd[f]
+
+        for grid in drain_to_grids(per_shard, B, make_grid, fill):
+            self.table = self._load_rows_sharded(
+                self.table,
+                type(grid)(*[
+                    jax.device_put(a, self._bsharding) for a in grid
+                ]),
+                np.int64(now),
+            )
+
+    def read_items_bulk(
+        self, keys: Sequence[str], include_cached: bool = False
+    ) -> Dict[str, CacheItem]:
+        """Batched point-reads (write-through readback): one sharded probe
+        per chunk + one fancy-index gather per table field."""
+        from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
+        now = self.clock.millisecond_now()
+        hashes = [key_hash64(k) for k in keys]
+        out: Dict[str, CacheItem] = {}
+        with self._lock:
+            found, gslot = self._probe_grid(keys, hashes, now)
+            if not found.any():
+                return out
+            sel = np.flatnonzero(found)
+            rows = {
+                f: np.asarray(getattr(self.table, f)[gslot[sel]])
+                for f in self.table._fields
+            }
+        for r_i, j in enumerate(sel):
+            if rows["kind"][r_i] == KIND_CACHED_RESP and not include_cached:
+                continue
+            out[keys[j]] = _row_to_item(rows, r_i, keys[j])
+        return out
+
     def snapshot(self) -> Dict[str, np.ndarray]:
         with self._lock:
             return table_to_host(self.table)
+
+    def _install_table(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Replace the sharded table from host arrays (checkpoint restore):
+        orbax round-trips the host copy; placement re-shards over the mesh.
+        """
+        from gubernator_tpu.ops.state import table_from_host
+
+        if arrays["key"].shape[0] != self.cfg.num_slots:
+            raise ValueError(
+                f"checkpoint has {arrays['key'].shape[0]} slots, backend "
+                f"expects {self.cfg.num_slots}"
+            )
+        with self._lock:
+            self.table = jax.device_put(
+                table_from_host(arrays), self._tsharding
+            )
 
     def occupancy(self) -> int:
         with self._lock:

@@ -44,7 +44,152 @@ from gubernator_tpu.ops.step import (
 )
 
 
-class DeviceBackend:
+def _h64s(hashes: Sequence[int]) -> np.ndarray:
+    """Unsigned 64-bit key fingerprints -> the int64 view stored on device."""
+    return np.array(hashes, dtype=np.uint64).view(np.int64)
+
+
+class PersistenceHost:
+    """Host-side Store/Loader plumbing shared by DeviceBackend and
+    MeshBackend (the SPI semantics of store.go:49-78 / workers.go:340-530).
+
+    Backends provide the device hooks:
+    - `_found_mask(keys, hashes, now)` -> bool[len(keys)] residency probe
+      (caller holds `_lock`; `hashes` are unsigned 64-bit ints);
+    - `_bulk_upsert(rows, hashes, now)` upserts row-field dicts (caller
+      holds `_lock`);
+    - `read_items_bulk(keys)` -> {key: CacheItem} (takes its own lock);
+    - `snapshot()` -> host arrays of the whole table.
+    Plus the attributes `cfg`, `clock`, `store`, `_keymap`, `_lock`, `table`.
+    """
+
+    def _maybe_prune_keymap(self) -> None:
+        """Bound the fingerprint->key map: the table holds at most num_slots
+        live rows, so once the map is 4x that, drop fingerprints no longer
+        resident (evicted/expired keys would otherwise accumulate forever).
+        """
+        assert self._keymap is not None
+        if len(self._keymap) <= max(4 * self.cfg.num_slots, 65_536):
+            return
+        with self._lock:
+            resident = set(
+                np.asarray(self.table.key).view(np.uint64).tolist()
+            )
+        self._keymap = {
+            fp: k for fp, k in self._keymap.items() if fp in resident
+        }
+
+    def _seed_from_store(self, reqs, packed, now: int) -> None:
+        """Consult Store.get for batch keys not resident on device and bulk
+        upsert the hits (the batched analog of algorithms.go:45-51).
+        Caller holds `_lock`."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
+        uniq: Dict[str, RateLimitReq] = {}
+        for i, r in enumerate(reqs):
+            if i not in packed.errors:
+                uniq.setdefault(r.hash_key(), r)
+        keys = list(uniq.keys())
+        if not keys:
+            return
+        hashes = [key_hash64(k) for k in keys]
+        found = self._found_mask(keys, hashes, now)
+        rows: List[dict] = []
+        row_hashes: List[int] = []
+        for k, h, f in zip(keys, hashes, found):
+            if f:
+                continue
+            item = self.store.get(uniq[k])
+            if item is None or item.is_expired(now):
+                continue
+            rows.append(item_to_row_fields(item))
+            row_hashes.append(h)
+        if rows:
+            self._bulk_upsert(rows, row_hashes, now)
+
+    def _write_through(self, reqs, packed, resps, use_cached=None) -> None:
+        """Read back post-step rows for persisted requests and hand them to
+        Store.on_change (the batched analog of algorithms.go:154-158).
+
+        Lanes served from GLOBAL broadcast cache (use_cached) are excluded —
+        their rows are replicated responses, not authoritative bucket state
+        (the reference only runs OnChange inside the owner's algorithm)."""
+        seen: set = set()
+        key_req: List[Tuple[str, RateLimitReq]] = []
+        for i, r in enumerate(reqs):
+            if i in packed.errors:
+                continue
+            if use_cached is not None and use_cached[i]:
+                continue
+            key = r.hash_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            key_req.append((key, r))
+        if not key_req:
+            return
+        items = self.read_items_bulk([k for k, _ in key_req])
+        for key, r in key_req:
+            item = items.get(key)
+            if item is not None:
+                self.store.on_change(r, item)
+
+    def load_items(self, items) -> int:
+        """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
+        chunk = 4 * self.cfg.batch_size
+        now = self.clock.millisecond_now()
+        n = 0
+        rows: List[dict] = []
+        hashes: List[int] = []
+        for item in items:
+            h = key_hash64(item.key)
+            if self._keymap is not None:
+                self._keymap[h] = item.key
+            rows.append(item_to_row_fields(item))
+            hashes.append(h)
+            n += 1
+            if len(rows) >= chunk:
+                with self._lock:
+                    self._bulk_upsert(rows, hashes, now)
+                rows, hashes = [], []
+        if rows:
+            with self._lock:
+                self._bulk_upsert(rows, hashes, now)
+        return n
+
+    def live_items(self) -> List[CacheItem]:
+        """All live rows as CacheItems (Loader save, workers.go:467-530).
+        Requires key tracking (a Store/Loader attached at construction)."""
+        if self._keymap is None:
+            raise RuntimeError(
+                "live_items() needs key tracking; construct the backend "
+                "with a store or track_keys=True"
+            )
+        from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
+        snap = self.snapshot()
+        now = self.clock.millisecond_now()
+        out: List[CacheItem] = []
+        # KIND_CACHED_RESP rows are replicated GLOBAL broadcast responses,
+        # not authoritative bucket state — saving them would resurrect them
+        # as owner buckets on restore.
+        live = np.flatnonzero(
+            (snap["key"] != 0)
+            & (snap["expire_at"] > now)
+            & (snap["kind"] != KIND_CACHED_RESP)
+        )
+        for s in live:
+            fp = int(np.int64(snap["key"][s]).view(np.uint64))
+            key = self._keymap.get(fp)
+            if key is None:
+                continue
+            out.append(_row_to_item(snap, s, key))
+        return out
+
+
+class DeviceBackend(PersistenceHost):
     """Single-table rate-limit engine on one device (or CPU backend)."""
 
     def __init__(
@@ -214,59 +359,23 @@ class DeviceBackend:
             )
         jax.block_until_ready(resp)
 
-    def _maybe_prune_keymap(self) -> None:
-        """Bound the fingerprint->key map: the table holds at most num_slots
-        live rows, so once the map is 4x that, drop fingerprints no longer
-        resident (evicted/expired keys would otherwise accumulate forever).
-        """
-        assert self._keymap is not None
-        if len(self._keymap) <= max(4 * self.cfg.num_slots, 65_536):
-            return
-        with self._lock:
-            resident = set(
-                np.asarray(self.table.key).view(np.uint64).tolist()
-            )
-        self._keymap = {
-            fp: k for fp, k in self._keymap.items() if fp in resident
-        }
+    # -- persistence device hooks (PersistenceHost) ----------------------
+    def _found_mask(self, keys, hashes, now: int) -> np.ndarray:
+        return self._probe_padded(_h64s(hashes), now)
 
-    # -- store write-through ---------------------------------------------
-    def _seed_from_store(self, reqs, packed, now: int) -> None:
-        """Consult Store.get for batch keys not resident on device and bulk
-        upsert the hits (the batched analog of algorithms.go:45-51)."""
-        from gubernator_tpu.runtime.store import item_to_row_fields
-
-        uniq: Dict[str, RateLimitReq] = {}
-        for i, r in enumerate(reqs):
-            if i not in packed.errors:
-                uniq.setdefault(r.hash_key(), r)
-        keys = list(uniq.keys())
-        if not keys:
-            return
-        hashes = np.array(
-            [np.uint64(key_hash64(k)) for k in keys], dtype=np.uint64
-        ).view(np.int64)
-        found = self._probe_padded(hashes, now)
-        rows: List[dict] = []
-        row_hashes: List[int] = []
-        for j, (k, f) in enumerate(zip(keys, found)):
-            if f:
-                continue
-            item = self.store.get(uniq[k])
-            if item is None or item.is_expired(now):
-                continue
-            rows.append(item_to_row_fields(item))
-            row_hashes.append(int(hashes[j]))
-        if not rows:
-            return
+    def _bulk_upsert(
+        self, rows: List[dict], hashes: List[int], now: int
+    ) -> None:
+        """Chunked load_rows over the fixed batch shape (lock held)."""
         B = self.cfg.batch_size
+        h64 = _h64s(hashes)
         for lo in range(0, len(rows), B):
             chunk = rows[lo:lo + B]
             pad = B - len(chunk)
             br = BucketRows(
-                key_hash=np.array(
-                    row_hashes[lo:lo + B] + [0] * pad, dtype=np.int64
-                ),
+                key_hash=np.concatenate([
+                    h64[lo:lo + B], np.zeros(pad, dtype=np.int64)
+                ]),
                 **{
                     f: np.array(
                         [c[f] for c in chunk] + [0] * pad,
@@ -318,33 +427,6 @@ class DeviceBackend:
                     out[k] = _row_to_item(rows, j, k)
         return out
 
-    def _write_through(self, reqs, packed, resps, use_cached=None) -> None:
-        """Read back post-step rows for persisted requests and hand them to
-        Store.on_change (the batched analog of algorithms.go:154-158).
-
-        Lanes served from GLOBAL broadcast cache (use_cached) are excluded —
-        their rows are replicated responses, not authoritative bucket state
-        (the reference only runs OnChange inside the owner's algorithm)."""
-        seen: set = set()
-        key_req: List[Tuple[str, RateLimitReq]] = []
-        for i, r in enumerate(reqs):
-            if i in packed.errors:
-                continue
-            if use_cached is not None and use_cached[i]:
-                continue
-            key = r.hash_key()
-            if key in seen:
-                continue
-            seen.add(key)
-            key_req.append((key, r))
-        if not key_req:
-            return
-        items = self.read_items_bulk([k for k, _ in key_req])
-        for key, r in key_req:
-            item = items.get(key)
-            if item is not None:
-                self.store.on_change(r, item)
-
     # -- GLOBAL broadcast receive ----------------------------------------
     def apply_cached_rows(self, rows: List[tuple]) -> None:
         """Upsert owner-broadcast statuses: rows of
@@ -385,75 +467,6 @@ class DeviceBackend:
                 )
                 self.table = self._store_cached(self.table, cr, np.int64(now))
 
-    # -- Loader bulk load/save -------------------------------------------
-    def load_items(self, items) -> int:
-        """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
-        from gubernator_tpu.runtime.store import item_to_row_fields
-
-        B = self.cfg.batch_size
-        now = self.clock.millisecond_now()
-        n = 0
-        batch_rows: List[dict] = []
-        batch_hashes: List[int] = []
-
-        def flush() -> None:
-            pad = B - len(batch_rows)
-            br = BucketRows(
-                key_hash=np.array(batch_hashes + [0] * pad, dtype=np.int64),
-                **{
-                    f: np.array(
-                        [c[f] for c in batch_rows] + [0] * pad,
-                        dtype=np.float64 if f == "remaining_f" else (
-                            np.int32 if f in ("algo", "status") else np.int64
-                        ),
-                    )
-                    for f in (
-                        "algo", "limit", "duration", "remaining",
-                        "remaining_f", "t0", "status", "burst", "expire_at",
-                    )
-                },
-            )
-            with self._lock:
-                self.table = self._load_rows(self.table, br, np.int64(now))
-            batch_rows.clear()
-            batch_hashes.clear()
-
-        for item in items:
-            if self._keymap is not None:
-                self._keymap[key_hash64(item.key)] = item.key
-            batch_rows.append(item_to_row_fields(item))
-            batch_hashes.append(
-                int(np.uint64(key_hash64(item.key)).view(np.int64))
-            )
-            n += 1
-            if len(batch_rows) == B:
-                flush()
-        if batch_rows:
-            flush()
-        return n
-
-    def live_items(self) -> List[CacheItem]:
-        """All live rows as CacheItems (Loader save, workers.go:467-530).
-        Requires key tracking (a Store/Loader attached at construction)."""
-        if self._keymap is None:
-            raise RuntimeError(
-                "live_items() needs key tracking; construct the backend with "
-                "a store or track_keys=True"
-            )
-        snap = self.snapshot()
-        now = self.clock.millisecond_now()
-        out: List[CacheItem] = []
-        live = np.flatnonzero(
-            (snap["key"] != 0) & (snap["expire_at"] > now)
-        )
-        for s in live:
-            fp = int(np.int64(snap["key"][s]).view(np.uint64))
-            key = self._keymap.get(fp)
-            if key is None:
-                continue
-            out.append(_row_to_item(snap, s, key))
-        return out
-
     # -- cache item access (GLOBAL path + persistence SPI) ---------------
     def get_cache_item(self, key: str) -> Optional[CacheItem]:
         """Point read of one key; reads only the key's bucket (`ways` slots),
@@ -470,6 +483,18 @@ class DeviceBackend:
         workers.go:467-530)."""
         with self._lock:
             return table_to_host(self.table)
+
+    def _install_table(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Replace the live table from host arrays (checkpoint restore)."""
+        from gubernator_tpu.ops.state import table_from_host
+
+        if arrays["key"].shape[0] != self.cfg.num_slots:
+            raise ValueError(
+                f"checkpoint has {arrays['key'].shape[0]} slots, backend "
+                f"expects {self.cfg.num_slots}"
+            )
+        with self._lock, jax.default_device(self._device):
+            self.table = table_from_host(arrays)
 
     def occupancy(self) -> int:
         with self._lock:
@@ -557,12 +582,21 @@ def unmarshal_responses(
 
 
 def probe_bucket(
-    table: SlotTable, lo: int, ways: int, key: str, now: int
+    table: SlotTable,
+    lo: int,
+    ways: int,
+    key: str,
+    now: int,
+    include_cached: bool = True,
 ) -> Optional[CacheItem]:
     """Host-side point read of one bucket: DMA `ways` rows starting at `lo`
     and return the live item for `key`, if any (the WorkerPool.GetCacheItem
     analog, workers.go:614-646; expired rows read as misses like
-    lrucache.go:115-127)."""
+    lrucache.go:115-127).  With include_cached=False, GLOBAL broadcast rows
+    (KIND_CACHED_RESP — replicated responses, not bucket state) read as
+    misses."""
+    from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
     rows = {
         f: np.asarray(getattr(table, f)[lo:lo + ways])
         for f in table._fields
@@ -570,6 +604,8 @@ def probe_bucket(
     h = int(np.uint64(key_hash64(key)).view(np.int64))
     for w in range(ways):
         if rows["key"][w] == h and rows["expire_at"][w] > now:
+            if not include_cached and rows["kind"][w] == KIND_CACHED_RESP:
+                return None
             return _row_to_item(rows, w, key)
     return None
 

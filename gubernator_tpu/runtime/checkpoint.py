@@ -28,7 +28,7 @@ from typing import Iterable, Iterator, List, Optional
 import numpy as np
 
 from gubernator_tpu.core.types import CacheItem
-from gubernator_tpu.ops.state import table_from_host, table_to_host
+from gubernator_tpu.ops.state import table_to_host
 from gubernator_tpu.runtime.backend import DeviceBackend
 from gubernator_tpu.runtime.store import Loader
 
@@ -67,7 +67,7 @@ class TableCheckpointer:
 
     def save(
         self,
-        backend: DeviceBackend,
+        backend,  # DeviceBackend or MeshBackend
         step: int,
         keep: int = 3,
     ) -> str:
@@ -90,8 +90,11 @@ class TableCheckpointer:
         log.info("checkpointed table to %s", path)
         return path
 
-    def restore(self, backend: DeviceBackend, step: Optional[int] = None) -> int:
-        """Restore the table in place; returns the restored step."""
+    def restore(self, backend, step: Optional[int] = None) -> int:
+        """Restore the table in place; returns the restored step.  Works
+        for DeviceBackend and MeshBackend alike — `_install_table` handles
+        placement (sharded over the mesh for the latter; orbax stores the
+        host copy either way)."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -103,8 +106,7 @@ class TableCheckpointer:
         arrays = {
             f: np.asarray(v) for f, v in payload["table"].items()
         }
-        with backend._lock:
-            backend.table = table_from_host(arrays)
+        backend._install_table(arrays)
         km_path = os.path.join(path, "keymap.json")
         if os.path.exists(km_path) and backend._keymap is not None:
             with open(km_path) as f:

@@ -98,8 +98,8 @@ class Service:
         if backend is not None:
             self.backend = backend
         elif self.cfg.device.num_shards > 1:
-            # Multi-chip: shard the table over the device mesh.  (Store/
-            # Loader SPI is single-device; use TableCheckpointer there.)
+            # Multi-chip: shard the table over the device mesh (full
+            # Store/Loader SPI, same as the single-device backend).
             from gubernator_tpu.parallel.sharded import MeshBackend
 
             self.backend = MeshBackend(
@@ -1070,7 +1070,13 @@ class MultiRegionManager:
                                 peer.info().grpc_address, e,
                             )
                             break
-                        await asyncio.sleep(self.sync_wait_s)
+                        # Floor the backoff at 200ms*attempt: a restarted
+                        # peer's gRPC channel needs ~1s to reconnect, and
+                        # sync_wait-paced retries (500µs default) would all
+                        # fail inside that window and drop the hits.
+                        await asyncio.sleep(
+                            max(0.2 * attempts, self.sync_wait_s)
+                        )
 
         await asyncio.gather(
             *(flush_one(p, b) for p, b in by_peer.values())

