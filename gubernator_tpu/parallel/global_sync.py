@@ -262,7 +262,23 @@ class GlobalEngine:
         )
         for db in packed.rounds:
             np.copyto(db.use_cached, db.active)
-        now = np.int64(self.clock.millisecond_now())
+        now_ms = self.clock.millisecond_now()
+        now = np.int64(now_ms)
+
+        # Persistence hooks, same contract as the backend hot path: record
+        # key strings for Loader save, and seed never-seen keys from the
+        # Store (a persisted GLOBAL bucket must survive a restart instead of
+        # resetting to full remaining until the first broadcast read-back).
+        if self.b._keymap is not None:
+            for j, r in enumerate(agg_reqs):
+                if j not in packed.errors:
+                    k = r.hash_key()
+                    self.b._keymap[key_hash64(k)] = k
+            self.b._maybe_prune_keymap()
+        if self.b.store is not None:
+            # Lock order everywhere: auth (backend) before cache (self).
+            with self.b._lock, self._lock:
+                self._seed_from_store_engine(agg_reqs, packed, now_ms)
 
         round_resps = []
         with self._lock:
@@ -301,6 +317,44 @@ class GlobalEngine:
         return [agg_out[j] for j in idx_map]
 
     # -- sync path -------------------------------------------------------
+    def _seed_from_store_engine(self, agg_reqs, packed, now_ms: int) -> None:
+        """Store.get for batch keys with no live row in the replicated
+        cache; hits upsert into BOTH tables — the auth table (owner-routed,
+        where sync applies hits, the s.Get of algorithms.go:45-51) and the
+        cache table (arrival-routed, so pre-sync serving reflects persisted
+        state, not a fresh bucket).  Caller holds b._lock then self._lock."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
+        uniq: Dict[str, RateLimitReq] = {}
+        for j, r in enumerate(agg_reqs):
+            if j not in packed.errors:
+                uniq.setdefault(r.hash_key(), r)
+        if not uniq:
+            return
+        from gubernator_tpu.core.hashing import key_hash64
+
+        keys = list(uniq)
+        hashes = [key_hash64(k) for k in keys]
+        route = lambda h: arrival_dev(h, self.n)  # noqa: E731
+        found, _ = self.b._probe_grid(
+            keys, hashes, now_ms, table=self.cache_table, route=route
+        )
+        rows: List[dict] = []
+        row_hashes: List[int] = []
+        for k, h, f in zip(keys, hashes, found):
+            if f:
+                continue
+            item = self.b.store.get(uniq[k])
+            if item is None or item.is_expired(now_ms):
+                continue
+            rows.append(item_to_row_fields(item))
+            row_hashes.append(h)
+        if rows:
+            self.b._bulk_upsert(rows, row_hashes, now_ms)
+            self.cache_table = self.b._bulk_upsert_into(
+                self.cache_table, rows, row_hashes, now_ms, route
+            )
+
     def sync(self) -> int:
         """Run the collective hits->owner->broadcast step; returns #keys."""
         with self._lock:
@@ -310,18 +364,30 @@ class GlobalEngine:
         now_dt = self.clock.now()
         chunks = self._build_chunks(pending, now_dt)
         now = np.int64(self.clock.millisecond_now())
-        for grid in chunks:
-            sharded = DeltaGrid(
-                *[jax.device_put(a, self.b._bsharding) for a in grid]
-            )
-            # Lock order: auth (backend) before cache (self).
-            with self.b._lock, self._lock:
+        captured = None
+        # Lock order: auth (backend) before cache (self).
+        with self.b._lock, self._lock:
+            for grid in chunks:
+                sharded = DeltaGrid(
+                    *[jax.device_put(a, self.b._bsharding) for a in grid]
+                )
                 self.b.table, self.cache_table = self._sync_step(
                     self.b.table, self.cache_table, sharded, now
                 )
-        with self._lock:
+            if self.b.store is not None:
+                # Post-sync auth rows -> Store.on_change (the write-through
+                # of algorithms.go:154-158, batch-granular at the sync tier;
+                # captured inside the lock, delivered outside).
+                items = self.b._read_items_locked(list(pending.keys()))
+                captured = [
+                    (p.req, items[key])
+                    for key, p in pending.items() if key in items
+                ]
             self.syncs += 1
             self.sync_keys += len(pending)
+        if captured:
+            for req, item in captured:
+                self.b.store.on_change(req, item)
         if self.on_synced is not None:
             self.on_synced(pending)
         return len(pending)

@@ -283,6 +283,7 @@ class MeshBackend(PersistenceHost):
             self._maybe_prune_keymap()
 
         round_resps = []
+        captured = None
         with self._lock:
             if self.store is not None:
                 self._seed_from_store(reqs, packed, now_ms)
@@ -291,13 +292,19 @@ class MeshBackend(PersistenceHost):
                 batch = jax.device_put(pack_grid_batch(db), self._psharding)
                 self.table, resp = self._step_packed(self.table, batch, now)
                 round_resps.append(resp)
+            if self.store is not None:
+                # Read-back inside the lock: a concurrent batch must not
+                # mutate a key between this batch's step and on_change.
+                captured = self._capture_write_through(
+                    reqs, packed, use_cached
+                )
         out, tally = unmarshal_responses(
             len(reqs), packed.errors, packed.positions,
             packed_grid_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
-        if self.store is not None:
-            self._write_through(reqs, packed, out, use_cached)
+        if captured:
+            self._deliver_write_through(captured)
         return out
 
     def warmup(self) -> None:
@@ -414,13 +421,24 @@ class MeshBackend(PersistenceHost):
         )
 
     # -- persistence device hooks (PersistenceHost) ----------------------
-    def _probe_grid(self, keys: Sequence[str], hashes, now: int):
+    def _probe_grid(
+        self, keys: Sequence[str], hashes, now: int,
+        table: Optional[SlotTable] = None, route=None,
+    ):
         """Shard-routed batched probes: (found, global_slot) per key, in
-        key order, one jitted probe per chunk (lock held)."""
+        key order, one jitted probe per chunk (lock held).
+
+        `table`/`route` default to the auth table with owner routing; the
+        GlobalEngine passes its replicated cache table with arrival-device
+        routing."""
+        if table is None:
+            table = self.table
         n, B = self.cfg.num_shards, self.cfg.batch_size
+        if route is None:
+            route = lambda h: int(shard_of_hash(h, n))  # noqa: E731
         per_shard: List[list] = [[] for _ in range(n)]
         for j, h in enumerate(hashes):
-            per_shard[int(shard_of_hash(h, n))].append((j, h))
+            per_shard[route(h)].append((j, h))
 
         found = np.zeros(len(keys), dtype=bool)
         gslot = np.zeros(len(keys), dtype=np.int64)
@@ -438,7 +456,7 @@ class MeshBackend(PersistenceHost):
 
         for hv, jv in drain_to_grids(per_shard, B, make_grid, fill):
             f, slot = self._probe_sharded(
-                self.table,
+                table,
                 jax.device_put(hv, self._bsharding),
                 np.int64(now),
             )
@@ -459,12 +477,23 @@ class MeshBackend(PersistenceHost):
     ) -> None:
         """Route row dicts to their shards and upsert via the sharded
         load_rows step (lock held)."""
+        self.table = self._bulk_upsert_into(self.table, rows, hashes, now)
+
+    def _bulk_upsert_into(
+        self, table: SlotTable, rows: List[dict], hashes: List[int],
+        now: int, route=None,
+    ) -> SlotTable:
+        """Upsert row dicts into `table` with `route` (defaults to owner
+        routing); returns the new table.  The GlobalEngine seeds its cache
+        table through this with arrival-device routing (lock held)."""
         from gubernator_tpu.ops.step import BucketRows
 
         n, B = self.cfg.num_shards, self.cfg.batch_size
+        if route is None:
+            route = lambda h: int(shard_of_hash(h, n))  # noqa: E731
         per_shard: List[list] = [[] for _ in range(n)]
         for row, h in zip(rows, hashes):
-            per_shard[int(shard_of_hash(h, n))].append((h, row))
+            per_shard[route(h)].append((h, row))
         fields = (
             "algo", "limit", "duration", "remaining", "remaining_f",
             "t0", "status", "burst", "expire_at",
@@ -491,33 +520,41 @@ class MeshBackend(PersistenceHost):
                 getattr(grid, f)[s, lane] = rd[f]
 
         for grid in drain_to_grids(per_shard, B, make_grid, fill):
-            self.table = self._load_rows_sharded(
-                self.table,
+            table = self._load_rows_sharded(
+                table,
                 type(grid)(*[
                     jax.device_put(a, self._bsharding) for a in grid
                 ]),
                 np.int64(now),
             )
+        return table
 
     def read_items_bulk(
         self, keys: Sequence[str], include_cached: bool = False
     ) -> Dict[str, CacheItem]:
         """Batched point-reads (write-through readback): one sharded probe
         per chunk + one fancy-index gather per table field."""
+        with self._lock:
+            return self._read_items_locked(keys, include_cached)
+
+    def _read_items_locked(
+        self, keys: Sequence[str], include_cached: bool = False
+    ) -> Dict[str, CacheItem]:
+        """read_items_bulk body; caller holds `_lock` (write-through capture
+        reads back rows within the same critical section as the step)."""
         from gubernator_tpu.ops.state import KIND_CACHED_RESP
 
         now = self.clock.millisecond_now()
         hashes = [key_hash64(k) for k in keys]
         out: Dict[str, CacheItem] = {}
-        with self._lock:
-            found, gslot = self._probe_grid(keys, hashes, now)
-            if not found.any():
-                return out
-            sel = np.flatnonzero(found)
-            rows = {
-                f: np.asarray(getattr(self.table, f)[gslot[sel]])
-                for f in self.table._fields
-            }
+        found, gslot = self._probe_grid(keys, hashes, now)
+        if not found.any():
+            return out
+        sel = np.flatnonzero(found)
+        rows = {
+            f: np.asarray(getattr(self.table, f)[gslot[sel]])
+            for f in self.table._fields
+        }
         for r_i, j in enumerate(sel):
             if rows["kind"][r_i] == KIND_CACHED_RESP and not include_cached:
                 continue

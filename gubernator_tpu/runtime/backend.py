@@ -107,9 +107,14 @@ class PersistenceHost:
         if rows:
             self._bulk_upsert(rows, row_hashes, now)
 
-    def _write_through(self, reqs, packed, resps, use_cached=None) -> None:
-        """Read back post-step rows for persisted requests and hand them to
-        Store.on_change (the batched analog of algorithms.go:154-158).
+    def _capture_write_through(
+        self, reqs, packed, use_cached=None
+    ) -> List[Tuple[RateLimitReq, CacheItem]]:
+        """Read back post-step rows for persisted requests while the caller
+        STILL HOLDS `_lock` — a concurrent batch must not mutate a key
+        between this batch's step and its Store.on_change read-back (the
+        reference calls OnChange synchronously inside the algorithm,
+        algorithms.go:154-158).
 
         Lanes served from GLOBAL broadcast cache (use_cached) are excluded —
         their rows are replicated responses, not authoritative bucket state
@@ -127,12 +132,16 @@ class PersistenceHost:
             seen.add(key)
             key_req.append((key, r))
         if not key_req:
-            return
-        items = self.read_items_bulk([k for k, _ in key_req])
-        for key, r in key_req:
-            item = items.get(key)
-            if item is not None:
-                self.store.on_change(r, item)
+            return []
+        items = self._read_items_locked([k for k, _ in key_req])
+        return [(r, items[k]) for k, r in key_req if k in items]
+
+    def _deliver_write_through(self, captured) -> None:
+        """Hand captured post-step items to Store.on_change.  Runs OUTSIDE
+        `_lock`: on_change is user code and must not be able to deadlock
+        against backend entry points that take the lock."""
+        for r, item in captured:
+            self.store.on_change(r, item)
 
     def load_items(self, items) -> int:
         """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
@@ -276,6 +285,7 @@ class DeviceBackend(PersistenceHost):
                     self._keymap[key_hash64(k)] = k
             self._maybe_prune_keymap()
         round_resps = []
+        captured = None
         t_start = time.monotonic()
         with self._lock:
             if self.store is not None:
@@ -288,6 +298,12 @@ class DeviceBackend(PersistenceHost):
                         self.table, _to_device(db), np.int64(now)
                     )
                     round_resps.append(packed_resp)
+            if self.store is not None:
+                # Read-back inside the lock: a concurrent batch must not
+                # mutate a key between this batch's step and on_change.
+                captured = self._capture_write_through(
+                    reqs, packed, use_cached
+                )
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start
@@ -299,8 +315,8 @@ class DeviceBackend(PersistenceHost):
             packed_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
-        if self.store is not None:
-            self._write_through(reqs, packed, out, use_cached)
+        if captured:
+            self._deliver_write_through(captured)
         return out
 
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
@@ -397,6 +413,14 @@ class DeviceBackend(PersistenceHost):
         """Batched point-reads: probe + device-side row gather in fixed-size
         chunks, one host sync per chunk.  KIND_CACHED_RESP rows (GLOBAL
         broadcast cache, not bucket state) are skipped unless asked for."""
+        with self._lock:
+            return self._read_items_locked(keys, include_cached)
+
+    def _read_items_locked(
+        self, keys: Sequence[str], include_cached: bool = False
+    ) -> Dict[str, CacheItem]:
+        """read_items_bulk body; caller holds `_lock` (write-through capture
+        reads back rows within the same critical section as the step)."""
         from gubernator_tpu.ops.state import KIND_CACHED_RESP
 
         B = self.cfg.batch_size
@@ -405,26 +429,25 @@ class DeviceBackend(PersistenceHost):
             [np.uint64(key_hash64(k)) for k in keys], dtype=np.uint64
         ).view(np.int64)
         out: Dict[str, CacheItem] = {}
-        with self._lock:
-            for lo in range(0, len(keys), B):
-                chunk_keys = keys[lo:lo + B]
-                padded = np.zeros(B, dtype=np.int64)
-                padded[: len(chunk_keys)] = hashes[lo:lo + B]
-                found, slot = self._probe(self.table, padded, np.int64(now))
-                rows = {
-                    f: np.asarray(getattr(self.table, f)[slot])
-                    for f in self.table._fields
-                }
-                found = np.asarray(found)
-                for j, k in enumerate(chunk_keys):
-                    if not found[j]:
-                        continue
-                    if (
-                        rows["kind"][j] == KIND_CACHED_RESP
-                        and not include_cached
-                    ):
-                        continue
-                    out[k] = _row_to_item(rows, j, k)
+        for lo in range(0, len(keys), B):
+            chunk_keys = keys[lo:lo + B]
+            padded = np.zeros(B, dtype=np.int64)
+            padded[: len(chunk_keys)] = hashes[lo:lo + B]
+            found, slot = self._probe(self.table, padded, np.int64(now))
+            rows = {
+                f: np.asarray(getattr(self.table, f)[slot])
+                for f in self.table._fields
+            }
+            found = np.asarray(found)
+            for j, k in enumerate(chunk_keys):
+                if not found[j]:
+                    continue
+                if (
+                    rows["kind"][j] == KIND_CACHED_RESP
+                    and not include_cached
+                ):
+                    continue
+                out[k] = _row_to_item(rows, j, k)
         return out
 
     # -- GLOBAL broadcast receive ----------------------------------------
