@@ -1,0 +1,281 @@
+"""Service-path benchmark: the FULL daemon pipeline, not the bare kernel.
+
+Where bench.py measures the device hot loop alone, this drives real gRPC
+traffic through an in-process daemon — wire parse, validation, packing,
+device step, response serialization — and reports throughput plus request
+latency percentiles for the BASELINE.json configs:
+
+  1. token_1k      TOKEN_BUCKET, 1k keys, batched client traffic
+  2. leaky_1m_zipf LEAKY_BUCKET, 1M keys, Zipfian hits
+  3. global_4peer  Behavior=GLOBAL on a 4-daemon cluster (non-owner serving)
+  4. latency       small batches, p50/p99 GetRateLimits (north-star: <2ms)
+  5. cms_sketch    count-min-sketch approximate tier, 100M-key space
+
+Clients send PRE-SERIALIZED payloads over raw-bytes gRPC stubs so the
+measurement is the server pipeline + wire, not python-protobuf client cost
+(the reference benchmarks use compiled Go clients, benchmark_test.go:29-148).
+
+Prints one JSON line per config:
+  {"config", "checks_per_sec", "p50_ms", "p99_ms", "rpcs", "checks"}
+and a final "budget" line breaking the host pipeline into stages.
+
+Runs on whatever JAX platform is active (the real TPU chip under axon;
+JAX_PLATFORMS=cpu for a laptop run).  ~2-3 min including XLA compiles.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+
+def _percentiles(lat_s: List[float]) -> Tuple[float, float]:
+    a = np.asarray(lat_s) * 1000.0
+    return float(np.percentile(a, 50)), float(np.percentile(a, 99))
+
+
+async def drive(
+    addresses: List[str],
+    payloads: List[bytes],
+    seconds: float,
+    concurrency: int,
+    method: str = "/pb.gubernator.V1/GetRateLimits",
+) -> Tuple[int, List[float]]:
+    """Fire pre-serialized payloads at the daemon(s) with `concurrency`
+    in-flight RPCs; returns (rpc_count, per-rpc latencies)."""
+    import grpc.aio
+
+    channels = [grpc.aio.insecure_channel(a) for a in addresses]
+    stubs = [ch.unary_unary(method) for ch in channels]
+    lat: List[float] = []
+    count = 0
+
+    async def worker(wid: int) -> None:
+        nonlocal count
+        stub = stubs[wid % len(stubs)]
+        i = wid
+        t_end = time.perf_counter() + seconds
+        while time.perf_counter() < t_end:
+            p = payloads[i % len(payloads)]
+            t0 = time.perf_counter()
+            await stub(p)
+            lat.append(time.perf_counter() - t0)
+            count += 1
+            i += concurrency
+
+    await asyncio.gather(*[worker(w) for w in range(concurrency)])
+    for ch in channels:
+        await ch.close()
+    return count, lat
+
+
+def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
+                  algorithm=0, behavior=0, burst=0) -> bytes:
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    return pb.GetRateLimitsReq(requests=[
+        pb.RateLimitReq(
+            name=n, unique_key=k, hits=hits, limit=limit, duration=duration,
+            algorithm=algorithm, behavior=behavior, burst=burst,
+        )
+        for n, k in names_keys
+    ]).SerializeToString()
+
+
+def bench(seconds: float, concurrency: int) -> None:
+    """Sync driver: client coroutines run on each cluster's OWN loop —
+    grpc.aio multiplexes one poller per process, and a second event loop
+    polling it (server on the cluster loop, clients on another) thrashes
+    into BlockingIOError storms and 30x latency."""
+    from gubernator_tpu.core.config import DeviceConfig, SketchTierConfig
+    from gubernator_tpu.testing.cluster import Cluster
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # XLA:CPU copies the donated table per step, so step time scales
+        # with table size — keep the CPU smoke config small.  On TPU the
+        # step is an in-place HBM scatter and the big table is free.
+        dev_cfg = DeviceConfig(num_slots=1 << 18, ways=8, batch_size=4096)
+    else:
+        dev_cfg = DeviceConfig(num_slots=1 << 22, ways=8, batch_size=4096)
+    rng = np.random.default_rng(7)
+    results = []
+
+    def emit(config, checks, rpcs, lat, wall, extra=None):
+        p50, p99 = _percentiles(lat)
+        line = {
+            "config": config,
+            "checks_per_sec": round(checks / wall, 1),
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(p99, 3),
+            "rpcs": rpcs,
+            "checks": checks,
+            "concurrency": concurrency,
+        }
+        if extra:
+            line.update(extra)
+        results.append(line)
+        print(json.dumps(line), flush=True)
+
+    # ---- configs 1/2/4: single-node daemon (compiled fast lane) -------
+    c = Cluster.start_with([""], device=dev_cfg)
+    try:
+        addr = [c.daemons[0].grpc_address]
+
+        # Config 1: token bucket, 1k keys, batch 1000.
+        pays = [
+            build_payload([("bench_token", f"k{i}") for i in range(1000)])
+            for _ in range(1)
+        ]
+        c.run(drive(addr, pays, 1.0, concurrency), timeout=120)  # warm
+        t0 = time.perf_counter()
+        rpcs, lat = c.run(
+            drive(addr, pays, seconds, concurrency), timeout=120
+        )
+        emit("token_1k_batch1000", rpcs * 1000, rpcs, lat,
+             time.perf_counter() - t0)
+
+        # Config 2: leaky bucket, 1M keys, Zipfian batches.
+        n_keys = 1_000_000
+        zipf_pays = []
+        for _ in range(32):
+            ks = rng.zipf(1.3, size=1000) % n_keys
+            zipf_pays.append(build_payload(
+                [("bench_leaky", f"z{k}") for k in ks],
+                algorithm=1, limit=1_000_000, duration=60_000,
+            ))
+        c.run(drive(addr, zipf_pays, 1.0, concurrency), timeout=120)
+        t0 = time.perf_counter()
+        rpcs, lat = c.run(
+            drive(addr, zipf_pays, seconds, concurrency), timeout=120
+        )
+        emit("leaky_1m_zipfian", rpcs * 1000, rpcs, lat,
+             time.perf_counter() - t0)
+
+        # Config 4: latency, small batches (10 checks), low concurrency.
+        small = [
+            build_payload([("bench_lat", f"l{j}") for j in range(10)])
+            for _ in range(1)
+        ]
+        c.run(drive(addr, small, 0.5, 1), timeout=120)
+        t0 = time.perf_counter()
+        rpcs, lat = c.run(drive(addr, small, seconds, 4), timeout=120)
+        emit("latency_small_batch", rpcs * 10, rpcs, lat,
+             time.perf_counter() - t0, {"concurrency": 4})
+
+        # Host/device budget on the fast lane (per 1000-request batch).
+        fp = c.daemons[0].fastpath
+        from gubernator_tpu import native
+
+        budget = {"config": "budget_us_per_1000"}
+        if native.available():
+            pay = pays[0]
+            t0 = time.perf_counter()
+            for _ in range(100):
+                cols = native.parse_reqs(pay)
+            budget["parse"] = round((time.perf_counter() - t0) / 100 * 1e6)
+            t0 = time.perf_counter()
+            for _ in range(100):
+                rnd, lane, nr = native.assign_rounds(
+                    cols.hash, None, 1, dev_cfg.batch_size
+                )
+            budget["assign_rounds"] = round(
+                (time.perf_counter() - t0) / 100 * 1e6
+            )
+            z = np.zeros(cols.n, dtype=np.int64)
+            off = np.zeros(cols.n + 1, dtype=np.int64)
+            t0 = time.perf_counter()
+            for _ in range(100):
+                native.serialize_resps(z, z, z, z, b"", off)
+            budget["serialize"] = round(
+                (time.perf_counter() - t0) / 100 * 1e6
+            )
+            budget["fastpath_served"] = fp.served
+            budget["fastpath_fallbacks"] = fp.fallbacks
+        results.append(budget)
+        print(json.dumps(budget), flush=True)
+    finally:
+        c.stop()
+
+    # ---- config 5: CMS sketch tier daemon (fast lane declines; the
+    # sketch path is its own vectorized pipeline) -----------------------
+    from gubernator_tpu.core.config import DaemonConfig
+
+    sketch_conf = DaemonConfig(
+        device=dev_cfg,
+        sketch=SketchTierConfig(
+            names=["cms"], width=1 << 20, depth=4, window_ms=60_000,
+            use_pallas=(platform not in ("cpu",)),
+        ),
+    )
+    c = Cluster.start_with([""], device=dev_cfg, conf_template=sketch_conf)
+    try:
+        addr = [c.daemons[0].grpc_address]
+        cms_pays = []
+        for _ in range(32):
+            ks = rng.integers(0, 100_000_000, size=1000)
+            cms_pays.append(build_payload(
+                [("cms", f"s{k}") for k in ks],
+                limit=1_000_000, duration=60_000,
+            ))
+        c.run(drive(addr, cms_pays, 1.0, concurrency), timeout=120)
+        t0 = time.perf_counter()
+        rpcs, lat = c.run(
+            drive(addr, cms_pays, seconds, concurrency), timeout=120
+        )
+        emit("cms_sketch_100m_space", rpcs * 1000, rpcs, lat,
+             time.perf_counter() - t0)
+    finally:
+        c.stop()
+
+    # ---- config 3: GLOBAL on a 4-daemon cluster -----------------------
+    c = Cluster.start_with(["", "", "", ""], device=dev_cfg)
+    try:
+        from gubernator_tpu.core.types import Behavior
+
+        g_pays = [
+            build_payload(
+                [("bench_global", f"g{i}") for i in range(1000)],
+                behavior=int(Behavior.GLOBAL),
+            )
+        ]
+        addr = [c.daemons[0].grpc_address]
+        c.run(drive(addr, g_pays, 1.0, concurrency), timeout=120)
+        t0 = time.perf_counter()
+        rpcs, lat = c.run(
+            drive(addr, g_pays, seconds, concurrency), timeout=120
+        )
+        emit("global_4peer", rpcs * 1000, rpcs, lat,
+             time.perf_counter() - t0)
+    finally:
+        c.stop()
+
+    summary = {
+        "config": "summary",
+        "platform": platform,
+        "device": {
+            "num_slots": dev_cfg.num_slots,
+            "batch_size": dev_cfg.batch_size,
+        },
+        "configs": {r["config"]: r.get("checks_per_sec") for r in results
+                    if "checks_per_sec" in r and r["checks_per_sec"]},
+    }
+    print(json.dumps(summary), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--concurrency", type=int, default=8)
+    args = ap.parse_args()
+    bench(args.seconds, args.concurrency)
+
+
+if __name__ == "__main__":
+    main()

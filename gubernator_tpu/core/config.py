@@ -54,6 +54,12 @@ class DeviceConfig:
     batch_size: int = 1024
     num_shards: int = 1  # mesh axis size for the sharded table
     platform: Optional[str] = None  # None = jax default
+    # GLOBAL replicated-serving cache table size (mesh GlobalEngine only).
+    # None = num_slots, i.e. the engine DOUBLES the table HBM footprint;
+    # size it to the expected GLOBAL working set (usually a small fraction
+    # of the exact tier) to reclaim that memory.  Same divisibility /
+    # power-of-two-buckets-per-shard rules as num_slots.
+    global_cache_slots: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.num_slots % (self.ways * max(self.num_shards, 1)) != 0:
@@ -61,6 +67,16 @@ class DeviceConfig:
                 "num_slots must be divisible by ways*num_shards "
                 f"(got {self.num_slots}, {self.ways}, {self.num_shards})"
             )
+        if self.global_cache_slots is not None:
+            if self.global_cache_slots % (
+                self.ways * max(self.num_shards, 1)
+            ) != 0:
+                raise ValueError(
+                    "global_cache_slots must be divisible by "
+                    "ways*num_shards (got "
+                    f"{self.global_cache_slots}, {self.ways}, "
+                    f"{self.num_shards})"
+                )
 
 
 @dataclass
@@ -120,6 +136,8 @@ class DaemonConfig:
     # Persistence SPI (runtime.store.Loader / Store)
     loader: Optional[object] = None
     store: Optional[object] = None
+    # Approximate (count-min sketch) tier for selected limit names.
+    sketch: Optional[SketchTierConfig] = None
 
 
 @dataclass

@@ -79,14 +79,31 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
 
 
 class _V1Servicer:
-    """pb2 <-> Service adapter for the client-facing V1 service."""
+    """Wire <-> Service adapter for the client-facing V1 service.
+
+    GetRateLimits is registered RAW (payload bytes in, bytes out): the
+    compiled fast lane (runtime/fastpath.py) serves eligible batches with
+    zero per-request Python; everything else deserializes here and takes
+    the object path."""
 
     def __init__(self, daemon: "Daemon") -> None:
         self.d = daemon
 
-    async def GetRateLimits(self, request, context):
-        reqs = grpc_api.reqs_from_pb(request.requests)
+    async def GetRateLimits(self, payload: bytes, context):
         try:
+            fp = self.d.fastpath
+            if fp is not None:
+                out = await fp.check_raw(payload, peer_rpc=False)
+                if out is not None:
+                    return out
+            try:
+                request = pb.GetRateLimitsReq.FromString(payload)
+            except Exception as e:  # noqa: BLE001 — DecodeError etc.
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"failed to parse GetRateLimitsReq: {e}",
+                )
+            reqs = grpc_api.reqs_from_pb(request.requests)
             resps = await self.d.service.get_rate_limits(reqs)
         except ApiError as e:
             await context.abort(
@@ -94,7 +111,7 @@ class _V1Servicer:
             )
         return pb.GetRateLimitsResp(
             responses=grpc_api.resps_to_pb(resps)
-        )
+        ).SerializeToString()
 
     async def HealthCheck(self, request, context):
         h = await self.d.service.health_check()
@@ -102,13 +119,27 @@ class _V1Servicer:
 
 
 class _PeersServicer:
-    """pb2 <-> Service adapter for the peer-to-peer PeersV1 service."""
+    """Wire <-> Service adapter for the peer-to-peer PeersV1 service.
+    GetPeerRateLimits is raw like the client RPC — the owner side of
+    forwarded batches is the cluster hot path."""
 
     def __init__(self, daemon: "Daemon") -> None:
         self.d = daemon
 
-    async def GetPeerRateLimits(self, request, context):
+    async def GetPeerRateLimits(self, payload: bytes, context):
         try:
+            fp = self.d.fastpath
+            if fp is not None:
+                out = await fp.check_raw(payload, peer_rpc=True)
+                if out is not None:
+                    return out
+            try:
+                request = peers_pb2.GetPeerRateLimitsReq.FromString(payload)
+            except Exception as e:  # noqa: BLE001
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"failed to parse GetPeerRateLimitsReq: {e}",
+                )
             reqs = grpc_api.reqs_from_pb(request.requests)
             resps = await self.d.service.get_peer_rate_limits(reqs)
         except ApiError as e:
@@ -117,7 +148,7 @@ class _PeersServicer:
             )
         return peers_pb2.GetPeerRateLimitsResp(
             rate_limits=grpc_api.resps_to_pb(resps)
-        )
+        ).SerializeToString()
 
     async def UpdatePeerGlobals(self, request, context):
         globals_ = [grpc_api.global_from_pb(g) for g in request.globals]
@@ -162,6 +193,7 @@ class Daemon:
                 except ValueError:
                     pass  # another daemon in this process registered them
         self.service: Optional[Service] = None
+        self.fastpath = None
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._http_runner: Optional[web.AppRunner] = None
         self._pool = None
@@ -178,6 +210,7 @@ class Daemon:
             data_center=self.conf.data_center,
             loader=getattr(self.conf, "loader", None),
             store=getattr(self.conf, "store", None),
+            sketch=getattr(self.conf, "sketch", None),
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -189,6 +222,9 @@ class Daemon:
             metrics=self.metrics,
         )
         await self.service.start()
+        from gubernator_tpu.runtime.fastpath import FastPath
+
+        self.fastpath = FastPath(self.service)
 
         # gRPC server (daemon.go:101-126): both services on one listener.
         # 4MB recv cap: grpc-go's default, which reference peers assume.
@@ -201,8 +237,8 @@ class Daemon:
             interceptors=[_StatsInterceptor(self.metrics)],
         )
         server.add_generic_rpc_handlers((
-            grpc_api.v1_generic_handler(_V1Servicer(self)),
-            grpc_api.peers_generic_handler(_PeersServicer(self)),
+            grpc_api.v1_generic_handler(_V1Servicer(self), raw=True),
+            grpc_api.peers_generic_handler(_PeersServicer(self), raw=True),
         ))
         if self.tls is not None:
             port = server.add_secure_port(
@@ -241,6 +277,9 @@ class Daemon:
         if self._http_runner is not None:
             await self._http_runner.cleanup()
             self._http_runner = None
+        if self.fastpath is not None:
+            await self.fastpath.close()
+            self.fastpath = None
         if self.service is not None:
             await self.service.close()
 
@@ -307,6 +346,10 @@ class Daemon:
                 self.service.backend.occupancy()
             )
             self.metrics.cache_size.set(self.service.backend.occupancy())
+            if self.service.global_engine is not None:
+                self.metrics.global_cache_occupancy.set(
+                    self.service.global_engine.cache_occupancy()
+                )
         return web.Response(
             body=self.metrics.render(),
             content_type="text/plain",

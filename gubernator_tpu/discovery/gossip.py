@@ -34,7 +34,9 @@ log = logging.getLogger("gubernator_tpu.discovery.gossip")
 
 
 class _Member:
-    __slots__ = ("info", "incarnation", "last_heard", "dead", "pinged_at")
+    __slots__ = (
+        "info", "incarnation", "last_heard", "dead", "pinged_at", "probes"
+    )
 
     def __init__(self, info: PeerInfo, incarnation: int) -> None:
         self.info = info
@@ -42,6 +44,7 @@ class _Member:
         self.last_heard = time.monotonic()
         self.dead = False
         self.pinged_at: Optional[float] = None
+        self.probes = 0
 
 
 class GossipPool(Pool, asyncio.DatagramProtocol):
@@ -159,17 +162,28 @@ class GossipPool(Pool, asyncio.DatagramProtocol):
             age = now - m.last_heard
             if age <= suspect_s:
                 m.pinged_at = None
+                m.probes = 0
             elif not m.dead:
                 if m.pinged_at is None:
                     # Direct probe before declaring death (SWIM's ping):
                     # a live node acks with its state, refreshing
                     # last_heard before the grace below expires.
                     m.pinged_at = now
+                    m.probes = 1
                     self._send_ping(addr)
                 elif now - m.pinged_at > 2.0 * self.gossip_interval_s:
-                    m.dead = True
-                    changed = True
-                    log.info("gossip: %s suspected dead", addr)
+                    if m.probes < 3:
+                        # Re-probe: one lost UDP ping or ack must not kill
+                        # a live member (SWIM sends multiple probes before
+                        # a death verdict; peer-list flaps churn the hash
+                        # ring for everyone).
+                        m.pinged_at = now
+                        m.probes += 1
+                        self._send_ping(addr)
+                    else:
+                        m.dead = True
+                        changed = True
+                        log.info("gossip: %s suspected dead", addr)
             if m.dead and age > suspect_s + self.reap_after_s:
                 del self._members[addr]
                 changed = True

@@ -93,6 +93,34 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
     ]
     lib.gub_assign_rounds.restype = ctypes.c_int64
+    lib.gub_count_reqs.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.gub_count_reqs.restype = ctypes.c_int64
+    lib.gub_parse_reqs.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.gub_parse_reqs.restype = ctypes.c_int64
+    lib.gub_serialize_resps.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.gub_serialize_resps.restype = ctypes.c_int64
     return lib
 
 
@@ -149,3 +177,77 @@ def assign_rounds(
         out_lane,
     )
     return out_round, out_lane, int(n_rounds)
+
+
+class ParsedReqs:
+    """Columnar view of a GetRateLimitsReq payload (gub_parse_reqs)."""
+
+    __slots__ = (
+        "n", "hash", "err", "hits", "limit", "duration", "algo",
+        "behavior", "burst",
+    )
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self.hash = np.empty(n, dtype=np.int64)
+        self.err = np.empty(n, dtype=np.int32)
+        self.hits = np.empty(n, dtype=np.int64)
+        self.limit = np.empty(n, dtype=np.int64)
+        self.duration = np.empty(n, dtype=np.int64)
+        self.algo = np.empty(n, dtype=np.int32)
+        self.behavior = np.empty(n, dtype=np.int64)
+        self.burst = np.empty(n, dtype=np.int64)
+
+
+def parse_reqs(payload: bytes) -> Optional[ParsedReqs]:
+    """Parse raw GetRateLimitsReq / GetPeerRateLimitsReq bytes into columns.
+    Returns None when the native library is unavailable or the payload is
+    malformed (callers fall back to python-protobuf for the real error)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.gub_count_reqs(payload, len(payload))
+    if n < 0:
+        return None
+    cols = ParsedReqs(int(n))
+    got = lib.gub_parse_reqs(
+        payload, len(payload), n, cols.hash, cols.err, cols.hits,
+        cols.limit, cols.duration, cols.algo, cols.behavior, cols.burst,
+    )
+    if got != n:
+        return None
+    return cols
+
+
+def serialize_resps(
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+    err_blob: bytes,
+    err_off: np.ndarray,
+) -> bytes:
+    """Emit GetRateLimitsResp / GetPeerRateLimitsResp wire bytes from packed
+    response columns.  Native only (callers gate on available())."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(status)
+    # Worst case per item: 4 varint fields (<=11 B each) + submsg framing
+    # (<=6 B) + error bytes (+3 B framing).
+    cap = n * 50 + len(err_blob) + n * 3 + 16
+    out = np.empty(cap, dtype=np.uint8)
+    written = lib.gub_serialize_resps(
+        n,
+        np.ascontiguousarray(status, dtype=np.int64),
+        np.ascontiguousarray(limit, dtype=np.int64),
+        np.ascontiguousarray(remaining, dtype=np.int64),
+        np.ascontiguousarray(reset_time, dtype=np.int64),
+        err_blob,
+        np.ascontiguousarray(err_off, dtype=np.int64),
+        out,
+        cap,
+    )
+    if written < 0:
+        raise RuntimeError("serialize_resps buffer overflow")
+    return out[:written].tobytes()

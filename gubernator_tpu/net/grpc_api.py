@@ -160,16 +160,25 @@ class PeersV1Stub:
 # Server handler registration
 # --------------------------------------------------------------------------
 
-def v1_generic_handler(servicer) -> grpc.GenericRpcHandler:
+def v1_generic_handler(servicer, raw: bool = False) -> grpc.GenericRpcHandler:
     """Build the V1 generic handler for `servicer`, which must expose
     async (or sync, for a sync server) methods GetRateLimits(req, context)
-    and HealthCheck(req, context) operating on pb2 messages."""
+    and HealthCheck(req, context) operating on pb2 messages.
+
+    With raw=True, GetRateLimits receives the undeserialized payload bytes
+    and must return response bytes — the daemon's compiled fast lane
+    (runtime/fastpath.py) parses/serializes the wire format in C++ and a
+    python-protobuf round-trip here would throw that win away."""
     rpc = grpc.unary_unary_rpc_method_handler
     return grpc.method_handlers_generic_handler(V1_SERVICE, {
         "GetRateLimits": rpc(
             servicer.GetRateLimits,
-            request_deserializer=pb.GetRateLimitsReq.FromString,
-            response_serializer=pb.GetRateLimitsResp.SerializeToString,
+            request_deserializer=(
+                None if raw else pb.GetRateLimitsReq.FromString
+            ),
+            response_serializer=(
+                None if raw else pb.GetRateLimitsResp.SerializeToString
+            ),
         ),
         "HealthCheck": rpc(
             servicer.HealthCheck,
@@ -179,16 +188,22 @@ def v1_generic_handler(servicer) -> grpc.GenericRpcHandler:
     })
 
 
-def peers_generic_handler(servicer) -> grpc.GenericRpcHandler:
+def peers_generic_handler(
+    servicer, raw: bool = False
+) -> grpc.GenericRpcHandler:
     """Build the PeersV1 generic handler for `servicer` (GetPeerRateLimits /
-    UpdatePeerGlobals over pb2 messages)."""
+    UpdatePeerGlobals over pb2 messages; raw=True passes GetPeerRateLimits
+    payload bytes through for the compiled fast lane)."""
     rpc = grpc.unary_unary_rpc_method_handler
     return grpc.method_handlers_generic_handler(PEERS_SERVICE, {
         "GetPeerRateLimits": rpc(
             servicer.GetPeerRateLimits,
-            request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
+            request_deserializer=(
+                None if raw else peers_pb.GetPeerRateLimitsReq.FromString
+            ),
             response_serializer=(
-                peers_pb.GetPeerRateLimitsResp.SerializeToString
+                None if raw
+                else peers_pb.GetPeerRateLimitsResp.SerializeToString
             ),
         ),
         "UpdatePeerGlobals": rpc(

@@ -43,24 +43,51 @@ class PeerNotReadyError(RuntimeError):
     (the reference's PeerErr/IsNotReady, peer_client.go:549-573)."""
 
 
+# Connect-phase failure markers, matched against BOTH details() and
+# debug_error_string() (wording moves between the two across grpc-core
+# versions; checking both plus a marker set keeps classification stable).
+_UNSENT_MARKERS = (
+    "failed to connect",
+    "connection refused",
+    "connect failed",
+    "no connection established",
+    "name resolution",
+    "dns resolution failed",
+    "endpoints failed",
+)
+
+
 def provably_unsent(e: BaseException) -> bool:
     """True when a failed peer call provably never DELIVERED the request —
     i.e. retrying it cannot double-apply hits on the peer.
 
     Covers: local shutdown / queue-full (PeerNotReadyError raised before
-    any RPC), and UNAVAILABLE whose detail shows the connection was never
-    established.  A mid-RPC socket reset or timeout is NOT provably unsent
-    (the peer may have applied the batch before the response was lost).
+    any RPC), and UNAVAILABLE whose error data shows the connection was
+    never established.  A mid-RPC socket reset or timeout is NOT provably
+    unsent (the peer may have applied the batch before the response was
+    lost).  Duck-typed over code()/details()/debug_error_string() so the
+    classification is testable without fabricating cython AioRpcError
+    instances, and resilient to which field grpc-core puts the cause in.
     """
     if isinstance(e, PeerNotReadyError):
         return True
-    if (
-        isinstance(e, grpc.aio.AioRpcError)
-        and e.code() == grpc.StatusCode.UNAVAILABLE
-    ):
-        d = (e.details() or "").lower()
-        return "failed to connect" in d or "connection refused" in d
-    return False
+    code = getattr(e, "code", None)
+    if not callable(code):
+        return False
+    try:
+        if code() != grpc.StatusCode.UNAVAILABLE:
+            return False
+    except Exception:  # noqa: BLE001
+        return False
+    text = ""
+    for attr in ("details", "debug_error_string"):
+        f = getattr(e, attr, None)
+        if callable(f):
+            try:
+                text += (f() or "").lower()
+            except Exception:  # noqa: BLE001
+                pass
+    return any(m in text for m in _UNSENT_MARKERS)
 
 
 class PeerClient:
@@ -259,19 +286,32 @@ class PeerClient:
         while True:
             first = await self._queue.get()
             batch = [first]
-            deadline = time.monotonic() + wait_s
-            while len(batch) < limit:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    item = await asyncio.wait_for(
-                        self._queue.get(), timeout=remaining
-                    )
-                except asyncio.TimeoutError:
-                    break
-                batch.append(item)
-            await self._send_sem.acquire()
+            # From here the batch holds dequeued requests: a cancellation
+            # at any await below must fail their futures, not orphan
+            # callers forever (shutdown() currently drains first, but the
+            # invariant must not depend on that ordering).
+            try:
+                deadline = time.monotonic() + wait_s
+                while len(batch) < limit:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(
+                            self._queue.get(), timeout=remaining
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    batch.append(item)
+                await self._send_sem.acquire()
+            except asyncio.CancelledError:
+                err = PeerNotReadyError(
+                    f"peer {self.peer_info.grpc_address} batcher cancelled"
+                )
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(err)
+                raise
             asyncio.ensure_future(self._send_batch(batch))
 
     async def _send_batch(

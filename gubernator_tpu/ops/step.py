@@ -54,6 +54,12 @@ class Resp(NamedTuple):
     reset_time: jax.Array  # int64[B]
     persisted: jax.Array  # bool[B]; False = transient (state not stored)
     found: jax.Array      # bool[B]; matched a live slot
+    # POST-step stored remaining (truncated for leaky) — differs from the
+    # response `remaining` in corner branches (e.g. a token duration-renew
+    # on a hits=0 read reports the pre-renew value, algorithms.go:167).
+    # Seeds the fast lane's host-side duplicate cascade
+    # (runtime/fastpath.py).
+    stored: jax.Array     # int64[B]
 
 
 class DeviceBatchJ(NamedTuple):
@@ -351,6 +357,13 @@ def apply_batch_impl(
         ),
         persisted=persist & active,
         found=found,
+        stored=jnp.where(
+            cached_hit,
+            s_rem,
+            sel(
+                te_rem, tn_rem, _trunc_i64(lb4), _trunc_i64(ln_rem_f), r_lim
+            ),
+        ),
     )
 
     # ==== write back ====================================================
@@ -545,11 +558,11 @@ def apply_batch_packed_impl(
     now: jax.Array,
     ways: int = 8,
 ) -> Tuple[SlotTable, jax.Array]:
-    """apply_batch with the response packed into ONE int64[6, B] array —
-    a single device->host transfer per step instead of six.  Matters when
+    """apply_batch with the response packed into ONE int64[7, B] array —
+    a single device->host transfer per step instead of seven.  Matters when
     the host link has per-transfer latency (e.g. remote-device tunnels).
 
-    Rows: status, limit, remaining, reset_time, persisted, found.
+    Rows: status, limit, remaining, reset_time, persisted, found, stored.
     """
     new_table, r = apply_batch_impl(table, batch, now, ways)
     packed = jnp.stack([
@@ -559,6 +572,7 @@ def apply_batch_packed_impl(
         r.reset_time.astype(jnp.int64),
         r.persisted.astype(jnp.int64),
         r.found.astype(jnp.int64),
+        r.stored.astype(jnp.int64),
     ])
     return new_table, packed
 

@@ -205,8 +205,24 @@ class GlobalEngine:
         self.delta_slots = delta_slots
         self.batch_limit = batch_limit
         self.clock = backend.clock
+        # Replicated serving table: its OWN slot budget
+        # (DeviceConfig.global_cache_slots; default = num_slots, which
+        # doubles the table HBM footprint — size it to the GLOBAL working
+        # set to reclaim memory).
+        self.cache_slots = (
+            backend.cfg.global_cache_slots
+            if backend.cfg.global_cache_slots is not None
+            else backend.cfg.num_slots
+        )
+        self.cache_local = self.cache_slots // self.n
+        nb_local = self.cache_local // backend.cfg.ways
+        if nb_local & (nb_local - 1):
+            raise ValueError(
+                f"global cache buckets per shard ({nb_local}) must be a "
+                "power of two"
+            )
         self.cache_table: SlotTable = jax.device_put(
-            init_table(backend.cfg.num_slots), backend._tsharding
+            init_table(self.cache_slots), backend._tsharding
         )
         # Same packed sharded step as the backend hot path, run on the
         # cache table (single-transfer in and out).
@@ -364,30 +380,34 @@ class GlobalEngine:
         now_dt = self.clock.now()
         chunks = self._build_chunks(pending, now_dt)
         now = np.int64(self.clock.millisecond_now())
+        # Transfers don't read table state — stage them BEFORE taking the
+        # locks so concurrent checks only block for the sync steps, not
+        # the host->device puts.
+        staged = [
+            DeltaGrid(*[jax.device_put(a, self.b._bsharding) for a in grid])
+            for grid in chunks
+        ]
         captured = None
         # Lock order: auth (backend) before cache (self).
         with self.b._lock, self._lock:
-            for grid in chunks:
-                sharded = DeltaGrid(
-                    *[jax.device_put(a, self.b._bsharding) for a in grid]
-                )
+            for sharded in staged:
                 self.b.table, self.cache_table = self._sync_step(
                     self.b.table, self.cache_table, sharded, now
                 )
             if self.b.store is not None:
                 # Post-sync auth rows -> Store.on_change (the write-through
                 # of algorithms.go:154-158, batch-granular at the sync tier;
-                # captured inside the lock, delivered outside).
+                # captured inside the lock, delivered in ticket order).
                 items = self.b._read_items_locked(list(pending.keys()))
                 captured = [
                     (p.req, items[key])
                     for key, p in pending.items() if key in items
                 ]
+                wt_seq = self.b._wt_ticket()
             self.syncs += 1
             self.sync_keys += len(pending)
-        if captured:
-            for req, item in captured:
-                self.b.store.on_change(req, item)
+        if captured is not None:
+            self.b._deliver_write_through(captured, wt_seq)
         if self.on_synced is not None:
             self.on_synced(pending)
         return len(pending)
@@ -470,15 +490,31 @@ class GlobalEngine:
             )
 
     # -- point reads (tests / HealthCheck) -------------------------------
+    def _cache_bucket_offset(self, key: str, shard: int) -> int:
+        """Global row index of `key`'s bucket within the CACHE table (its
+        geometry may differ from the auth table's via global_cache_slots).
+        """
+        from gubernator_tpu.core.hashing import key_hash64
+
+        nb_local = self.cache_local // self.b.cfg.ways
+        bucket = key_hash64(key) & (nb_local - 1)
+        return shard * self.cache_local + bucket * self.b.cfg.ways
+
     def get_cached(self, key: str):
         """Read this key's row from its serving device's cache table."""
         from gubernator_tpu.core.hashing import key_hash64
         from gubernator_tpu.runtime.backend import probe_bucket
 
         dev = arrival_dev(key_hash64(key), self.n)
-        lo = self.b.bucket_offset(key, dev)
+        lo = self._cache_bucket_offset(key, dev)
         now = self.clock.millisecond_now()
         with self._lock:
             return probe_bucket(
                 self.cache_table, lo, self.b.cfg.ways, key, now
             )
+
+    def cache_occupancy(self) -> int:
+        """Live rows in the replicated serving table (HBM observability for
+        the 2x-table cost; exported as gubernator_global_cache_size)."""
+        with self._lock:
+            return int(np.asarray(self.cache_table.occupancy()))

@@ -122,7 +122,7 @@ def make_sharded_step_packed(mesh, ways: int):
 
 
 def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed [n, 6, B] responses — one transfer per round.
+    """Host view of packed [n, 7, B] responses — one transfer per round.
     Field arrays are [n, B], so (shard, lane) positions index directly."""
     out = []
     for p in round_resps:
@@ -134,6 +134,7 @@ def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "reset_time": a[:, 3],
             "persisted": a[:, 4],
             "found": a[:, 5],
+            "stored": a[:, 6],
         })
     return out
 
@@ -213,6 +214,7 @@ class MeshBackend(PersistenceHost):
         self.cfg = cfg
         self.clock = clock or clock_mod.default_clock()
         self._lock = threading.Lock()
+        self._init_write_through()
         self.mesh = make_mesh(cfg.num_shards, devices)
         self.local_slots = cfg.num_slots // cfg.num_shards
         nb_local = self.local_slots // cfg.ways
@@ -298,14 +300,36 @@ class MeshBackend(PersistenceHost):
                 captured = self._capture_write_through(
                     reqs, packed, use_cached
                 )
+                wt_seq = self._wt_ticket()
         out, tally = unmarshal_responses(
             len(reqs), packed.errors, packed.positions,
             packed_grid_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
-        if captured:
-            self._deliver_write_through(captured)
+        if captured is not None:
+            self._deliver_write_through(captured, wt_seq)
         return out
+
+    def step_rounds(
+        self, rounds: Sequence, add_tally: bool = True
+    ) -> List[Dict[str, np.ndarray]]:
+        """Columnar hot path over the mesh: apply pre-packed [n, B] grid
+        DeviceBatch rounds (the compiled fast lane, runtime/fastpath.py).
+        No persistence hooks — the fast lane requires no attached Store.
+        Returns [n, B]-shaped host response dicts per round."""
+        from gubernator_tpu.runtime.backend import tally_from_rounds
+
+        now = np.int64(self.clock.millisecond_now())
+        round_resps = []
+        with self._lock:
+            for db in rounds:
+                batch = jax.device_put(pack_grid_batch(db), self._psharding)
+                self.table, resp = self._step_packed(self.table, batch, now)
+                round_resps.append(resp)
+        host = packed_grid_rounds_to_host(round_resps)
+        if add_tally:
+            self._add_tally(tally_from_rounds(rounds, host))
+        return host
 
     def warmup(self) -> None:
         """Compile the sharded executables with a synthetic batch that
@@ -433,6 +457,9 @@ class MeshBackend(PersistenceHost):
         routing."""
         if table is None:
             table = self.table
+        # Table geometry may differ from the auth table's (the GlobalEngine
+        # cache can be smaller via global_cache_slots).
+        local_slots = table.key.shape[0] // self.cfg.num_shards
         n, B = self.cfg.num_shards, self.cfg.batch_size
         if route is None:
             route = lambda h: int(shard_of_hash(h, n))  # noqa: E731
@@ -465,7 +492,7 @@ class MeshBackend(PersistenceHost):
                 sel = jv[s] >= 0
                 js = jv[s][sel]
                 found[js] = f[s][sel]
-                gslot[js] = s * self.local_slots + slot[s][sel]
+                gslot[js] = s * local_slots + slot[s][sel]
         return found, gslot
 
     def _found_mask(self, keys, hashes, now: int) -> np.ndarray:

@@ -107,6 +107,25 @@ class PersistenceHost:
         if rows:
             self._bulk_upsert(rows, row_hashes, now)
 
+    def _init_write_through(self) -> None:
+        """Write-through delivery ordering state (backend __init__)."""
+        self._wt_seq = 0
+        self._wt_next = 0
+        self._wt_cond = threading.Condition()
+
+    def _wt_ticket(self) -> int:
+        """Next write-through delivery ticket (caller holds `_lock`).
+        Tickets order Store.on_change delivery across concurrent batches:
+        captures are per-batch-consistent, but without ordering a slower
+        thread could deliver an OLDER captured state after a newer one and
+        the store would diverge from the table (the reference orders
+        delivery by calling OnChange inside the per-key worker).  Every
+        ticket MUST be redeemed via _deliver_write_through (even with an
+        empty capture) or later deliveries stall."""
+        seq = self._wt_seq
+        self._wt_seq = seq + 1
+        return seq
+
     def _capture_write_through(
         self, reqs, packed, use_cached=None
     ) -> List[Tuple[RateLimitReq, CacheItem]]:
@@ -136,12 +155,23 @@ class PersistenceHost:
         items = self._read_items_locked([k for k, _ in key_req])
         return [(r, items[k]) for k, r in key_req if k in items]
 
-    def _deliver_write_through(self, captured) -> None:
-        """Hand captured post-step items to Store.on_change.  Runs OUTSIDE
-        `_lock`: on_change is user code and must not be able to deadlock
-        against backend entry points that take the lock."""
-        for r, item in captured:
-            self.store.on_change(r, item)
+    def _deliver_write_through(self, captured, seq: int) -> None:
+        """Hand captured post-step items to Store.on_change, in capture
+        order (`seq` from `_wt_ticket`).  Runs OUTSIDE `_lock` — on_change
+        is user code and must not be able to deadlock against backend
+        entry points — but a FIFO ticket wait preserves step order, so a
+        stale capture can never overwrite a newer one in the store."""
+        cond = self._wt_cond
+        with cond:
+            while self._wt_next != seq:
+                cond.wait()
+        try:
+            for r, item in captured:
+                self.store.on_change(r, item)
+        finally:
+            with cond:
+                self._wt_next += 1
+                cond.notify_all()
 
     def load_items(self, items) -> int:
         """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
@@ -213,6 +243,7 @@ class DeviceBackend(PersistenceHost):
         self.cfg = cfg or DeviceConfig()
         self.clock = clock or clock_mod.default_clock()
         self._lock = threading.Lock()
+        self._init_write_through()
         if self.cfg.platform is not None:
             self._device = jax.devices(self.cfg.platform)[0]
         else:
@@ -304,6 +335,7 @@ class DeviceBackend(PersistenceHost):
                 captured = self._capture_write_through(
                     reqs, packed, use_cached
                 )
+                wt_seq = self._wt_ticket()
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start
@@ -315,9 +347,37 @@ class DeviceBackend(PersistenceHost):
             packed_rounds_to_host(round_resps),
         )
         self._add_tally(tally)
-        if captured:
-            self._deliver_write_through(captured)
+        if captured is not None:
+            self._deliver_write_through(captured, wt_seq)
         return out
+
+    def step_rounds(
+        self, rounds: Sequence[DeviceBatch], add_tally: bool = True
+    ) -> List[Dict[str, np.ndarray]]:
+        """Columnar hot path: apply pre-packed [B] DeviceBatch rounds with
+        no per-request Python anywhere (the compiled fast lane,
+        runtime/fastpath.py).  Persistence hooks are NOT run — the fast
+        lane is only taken when no Store/Loader is attached.  Returns host
+        response dicts per round; with add_tally, tallies update
+        vectorized (the fast lane passes False and counts per REQUEST —
+        cascade occurrences share device lanes)."""
+        now = np.int64(self.clock.millisecond_now())
+        round_resps = []
+        t_start = time.monotonic()
+        with self._lock:
+            for db in rounds:
+                self.table, packed_resp = self._step_packed(
+                    self.table, _to_device(db), now
+                )
+                round_resps.append(packed_resp)
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
+        host = packed_rounds_to_host(round_resps)
+        if add_tally:
+            self._add_tally(tally_from_rounds(rounds, host))
+        return host
 
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
         """found-mask for a host hash vector, probing in fixed batch_size
@@ -543,13 +603,14 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "limit": np.asarray(r.limit),
             "persisted": np.asarray(r.persisted),
             "found": np.asarray(r.found),
+            "stored": np.asarray(r.stored),
         }
         for r in round_resps
     ]
 
 
 def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed int64[6, B] responses (apply_batch_packed row
+    """Host view of packed int64[7, B] responses (apply_batch_packed row
     order), one transfer per round."""
     out = []
     for p in round_packed:
@@ -561,8 +622,22 @@ def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
             "reset_time": a[3],
             "persisted": a[4],
             "found": a[5],
+            "stored": a[6],
         })
     return out
+
+
+def tally_from_rounds(rounds, round_host) -> "Tally":
+    """Vectorized Tally over packed rounds (active lanes only) — the
+    columnar analog of unmarshal_responses' per-request counting."""
+    checks = over = notp = hits = 0
+    for db, h in zip(rounds, round_host):
+        act = np.asarray(db.active)
+        checks += int(act.sum())
+        over += int(((h["status"] == 1) & act).sum())
+        notp += int(((h["persisted"] == 0) & act).sum())
+        hits += int(((h["found"] != 0) & act).sum())
+    return Tally(checks, over, notp, hits)
 
 
 def unmarshal_responses(

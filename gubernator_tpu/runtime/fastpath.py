@@ -1,0 +1,536 @@
+"""The compiled host hot path: raw wire bytes -> device step -> wire bytes.
+
+The object path (pb2 -> RateLimitReq dataclasses -> packer -> device ->
+RateLimitResp -> pb2) costs several microseconds of Python per request,
+which caps a daemon at ~10k checks/s while the device kernel does hundreds
+of millions — the round-2 verdict's top gap.  The reference has no such
+tax: its whole host loop is compiled Go (workers.go:249-314,
+peer_client.go:450-509, generated pb marshalers).
+
+This module is the equivalent compiled lane.  For eligible requests the
+daemon hands the raw gRPC payload straight here:
+
+    C++ parse  (native/gubtpu.cpp gub_parse_reqs: wire -> columns + XXH64)
+    numpy      (burst defaults, behavior masks, shard routing)
+    C++ pack   (gub_assign_rounds: duplicate-key round/lane assignment)
+    numpy      (scatter columns into fixed-shape DeviceBatch rounds)
+    device     (backend.step_rounds: the same jitted kernels as check())
+    numpy      (gather packed responses back to request order)
+    C++ emit   (gub_serialize_resps: columns -> response wire bytes)
+
+No per-request Python objects exist anywhere on this path.  Concurrent
+RPCs coalesce into shared device steps (the LocalBatcher discipline,
+runtime/service.py) by concatenating their columns before packing.
+
+Eligibility — anything else falls back to the object path, which remains
+the semantic reference:
+  - native library loadable;
+  - no Store / Loader / sketch tier attached (their hooks are per-key);
+  - no GLOBAL / MULTI_REGION behaviors in the batch (they route through
+    the managers);
+  - for the client-facing RPC: single-node (no peers to forward to).
+    Peer-to-peer batches (GetPeerRateLimits) are always local by
+    construction, so the fast lane also serves the owner side of
+    forwarded traffic in a cluster.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gubernator_tpu import native
+from gubernator_tpu.core.config import MAX_BATCH_SIZE
+from gubernator_tpu.core.interval import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.core.types import Behavior
+from gubernator_tpu.ops.batch import DeviceBatch, _empty_batch
+
+_ERR_EMPTY_KEY = b"field 'unique_key' cannot be empty"
+_ERR_EMPTY_NAME = b"field 'namespace' cannot be empty"
+_ERR_GREG = 3  # parse err code for host-side Gregorian failures
+
+_SKIP_MASK = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
+
+
+class FastPath:
+    """Per-service compiled lane with a coalescing columnar batcher."""
+
+    def __init__(self, service) -> None:
+        self.s = service
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        # Servings since start (observability; also asserted in tests to
+        # prove the fast lane actually ran).
+        self.served = 0
+        self.fallbacks = 0
+
+    # -- eligibility -----------------------------------------------------
+    def _eligible(self) -> bool:
+        b = self.s.backend
+        return (
+            native.available()
+            and b.store is None
+            and b._keymap is None
+            and self.s.sketch_backend is None
+        )
+
+    def _single_node(self) -> bool:
+        """True when no request can need a peer forward: an empty picker,
+        or a one-peer picker where that peer is this node."""
+        pick = self.s.local_picker
+        sz = pick.size()
+        if sz == 0:
+            return True
+        if sz > 1:
+            return False
+        return pick.peers()[0].info().is_owner
+
+    # -- entry point -----------------------------------------------------
+    async def check_raw(
+        self, payload: bytes, peer_rpc: bool
+    ) -> Optional[bytes]:
+        """Serve a GetRateLimits(Req) / GetPeerRateLimits(Req) payload on
+        the compiled lane; None = caller must take the object path.
+        Raises ApiError on an oversized batch (same contract as the
+        object path)."""
+        from gubernator_tpu.runtime.service import ApiError
+
+        if not self._eligible():
+            self.fallbacks += 1
+            return None
+        if not peer_rpc and not self._single_node():
+            self.fallbacks += 1
+            return None
+        cols = native.parse_reqs(payload)
+        if cols is None:
+            self.fallbacks += 1
+            return None
+        n = cols.n
+        if n > MAX_BATCH_SIZE:
+            # Metric parity with the object path (service.py rejects with
+            # the same counter on the client RPC, none on the peer RPC).
+            if peer_rpc:
+                raise ApiError(
+                    "OUT_OF_RANGE",
+                    "'PeerRequest.rate_limits' list too large; max size "
+                    "is '%d'" % MAX_BATCH_SIZE,
+                )
+            self.s.metrics.check_error_counter.labels(
+                error="Request too large"
+            ).inc()
+            raise ApiError(
+                "OUT_OF_RANGE",
+                "Requests.RateLimits list too large; max size is '%d'"
+                % MAX_BATCH_SIZE,
+            )
+        if n and (cols.behavior & _SKIP_MASK).any():
+            self.fallbacks += 1
+            return None
+        if n == 0:
+            return b""
+        if not peer_rpc:
+            # concurrent_checks parity with service.get_rate_limits.
+            self.s._inflight_checks += 1
+            self.s.metrics.concurrent_checks.observe(
+                self.s._inflight_checks
+            )
+        try:
+            return await self._serve(cols, n, peer_rpc)
+        finally:
+            if not peer_rpc:
+                self.s._inflight_checks -= 1
+
+    async def _serve(self, cols, n: int, peer_rpc: bool) -> bytes:
+        """Gregorian prep -> coalescing batcher -> response bytes."""
+        # Host-side Gregorian expiry (rare; only flagged lanes loop).
+        greg_expire = np.zeros(n, dtype=np.int64)
+        greg_duration = np.zeros(n, dtype=np.int64)
+        is_greg = (
+            cols.behavior & int(Behavior.DURATION_IS_GREGORIAN)
+        ) != 0
+        err_extra: Dict[int, bytes] = {}
+        if is_greg.any():
+            now_dt = self.s.clock.now()
+            for i in np.flatnonzero(is_greg):
+                i = int(i)
+                try:
+                    greg_expire[i] = gregorian_expiration(
+                        now_dt, int(cols.duration[i])
+                    )
+                    greg_duration[i] = gregorian_duration(
+                        now_dt, int(cols.duration[i])
+                    )
+                except GregorianError as e:
+                    err_extra[i] = str(e).encode()
+                    cols.err[i] = _ERR_GREG
+                    cols.hash[i] = 0
+
+        entry = _Entry(
+            cols=cols,
+            is_greg=is_greg,
+            greg_expire=greg_expire,
+            greg_duration=greg_duration,
+            fut=asyncio.get_running_loop().create_future(),
+        )
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+        await self._queue.put(entry)
+        status, limit, remaining, reset = await entry.fut
+
+        # Error strings (canned validation + Gregorian); zero on hot lanes.
+        blobs: List[bytes] = []
+        err_off = np.zeros(n + 1, dtype=np.int64)
+        if cols.err.any():
+            for i in np.flatnonzero(cols.err):
+                i = int(i)
+                code = int(cols.err[i])
+                e = (
+                    err_extra.get(i, b"")
+                    if code == _ERR_GREG
+                    else (_ERR_EMPTY_KEY if code == 1 else _ERR_EMPTY_NAME)
+                )
+                blobs.append(e)
+                err_off[i + 1] = len(e)
+            np.cumsum(err_off[1:], out=err_off[1:])
+        blob = b"".join(blobs)
+
+        self.served += n
+        return native.serialize_resps(
+            status, limit, remaining, reset, blob, err_off
+        )
+
+    # -- coalescing batcher ---------------------------------------------
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            entries = [await self._queue.get()]
+            while True:
+                try:
+                    entries.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            try:
+                outs = await loop.run_in_executor(
+                    self.s._dev_executor, lambda: self._process(entries)
+                )
+            except Exception as e:  # noqa: BLE001
+                for en in entries:
+                    if not en.fut.done():
+                        en.fut.set_exception(e)
+                continue
+            for en, out in zip(entries, outs):
+                if not en.fut.done():
+                    en.fut.set_result(out)
+
+    def _process(
+        self, entries: Sequence["_Entry"]
+    ) -> List[Tuple[np.ndarray, ...]]:
+        """Pack -> step -> gather for a coalesced entry list (runs on the
+        device-executor thread; everything here is numpy/C++/device).
+
+        Duplicate-heavy batches (Zipfian hot keys) would otherwise explode
+        into one device round PER OCCURRENCE of the hottest key; eligible
+        duplicate groups instead take the host-cascade path (_plan_cascade):
+        one read lane, an exact host-side replay of the per-occurrence
+        algorithm branches, and one effective write-back lane — two rounds
+        total regardless of skew."""
+        from gubernator_tpu.runtime.backend import Tally, tally_from_rounds
+
+        backend = self.s.backend
+        cfg = backend.cfg
+        n_shards = cfg.num_shards
+        B = cfg.batch_size
+
+        if len(entries) == 1:
+            c = entries[0].cols
+            h, hits, lim, dur = c.hash, c.hits, c.limit, c.duration
+            algo, burst, behavior = c.algo, c.burst, c.behavior
+            is_greg = entries[0].is_greg
+            ge, gd = entries[0].greg_expire, entries[0].greg_duration
+        else:
+            h = np.concatenate([e.cols.hash for e in entries])
+            hits = np.concatenate([e.cols.hits for e in entries])
+            lim = np.concatenate([e.cols.limit for e in entries])
+            dur = np.concatenate([e.cols.duration for e in entries])
+            algo = np.concatenate([e.cols.algo for e in entries])
+            burst = np.concatenate([e.cols.burst for e in entries])
+            behavior = np.concatenate([e.cols.behavior for e in entries])
+            is_greg = np.concatenate([e.is_greg for e in entries])
+            ge = np.concatenate([e.greg_expire for e in entries])
+            gd = np.concatenate([e.greg_duration for e in entries])
+        n = len(h)
+
+        burst = np.where(burst == 0, lim, burst)
+        reset_remaining = (behavior & int(Behavior.RESET_REMAINING)) != 0
+
+        plan = _plan_cascade(h, hits, reset_remaining, is_greg,
+                             lim, dur, algo, burst)
+        if plan is None:
+            h_mach, hits_mach = h, hits
+        else:
+            h_mach = h.copy()
+            hits_mach = hits.copy()
+            h_mach[plan.occ] = 0          # divert cascade occurrences
+            h_mach[plan.firsts] = h[plan.firsts]  # keep one READ lane
+            hits_mach[plan.firsts] = 0
+
+        if n_shards > 1:
+            from gubernator_tpu.parallel.mesh import shard_of_hash
+
+            sh_all = shard_of_hash(h, n_shards).astype(np.int32)
+        else:
+            sh_all = np.zeros(n, dtype=np.int32)
+        rnd, lane, n_rounds = native.assign_rounds(
+            h_mach, sh_all if n_shards > 1 else None, n_shards, B
+        )
+
+        values = dict(
+            key_hash=h_mach, hits=hits_mach, limit=lim, duration=dur,
+            algo=algo, burst=burst, reset_remaining=reset_remaining,
+            is_greg=is_greg, greg_expire=ge, greg_duration=gd,
+        )
+        rounds, order, bounds = _build_rounds(
+            values, rnd, lane, sh_all, n_rounds, n_shards, B
+        )
+        host = backend.step_rounds(rounds, add_tally=False)
+
+        status = np.zeros(n, dtype=np.int64)
+        out_lim = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        stored = np.zeros(n, dtype=np.int64)
+        for r_idx in range(n_rounds):
+            sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+            hr = host[r_idx]
+            if n_shards > 1:
+                idx = (sh_all[sel], lane[sel])
+            else:
+                idx = (lane[sel],)
+            status[sel] = hr["status"][idx]
+            out_lim[sel] = hr["limit"][idx]
+            remaining[sel] = hr["remaining"][idx]
+            reset[sel] = hr["reset_time"][idx]
+            stored[sel] = hr["stored"][idx]
+
+        if plan is not None:
+            wb = _run_cascade(
+                plan, h, hits, lim, dur, algo, burst,
+                status, out_lim, remaining, reset, stored,
+            )
+            if wb is not None:
+                wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
+                wb_sh = (
+                    shard_of_hash(wb_h, n_shards).astype(np.int32)
+                    if n_shards > 1 else None
+                )
+                wrnd, wlane, wn = native.assign_rounds(
+                    wb_h, wb_sh, n_shards, B
+                )
+                m = len(wb_h)
+                wvals = dict(
+                    key_hash=wb_h, hits=wb_hits, limit=wb_lim,
+                    duration=wb_dur, algo=wb_algo, burst=wb_burst,
+                    reset_remaining=np.zeros(m, dtype=bool),
+                    is_greg=np.zeros(m, dtype=bool),
+                    greg_expire=np.zeros(m, dtype=np.int64),
+                    greg_duration=np.zeros(m, dtype=np.int64),
+                )
+                wb_rounds, _, _ = _build_rounds(
+                    wvals, wrnd, wlane,
+                    wb_sh if wb_sh is not None
+                    else np.zeros(m, dtype=np.int32),
+                    wn, n_shards, B,
+                )
+                backend.step_rounds(wb_rounds, add_tally=False)
+
+        # Metric parity: checks/over-limit from the per-REQUEST outputs
+        # (cascade occurrences never had their own device lane); cache
+        # hit/miss + eviction tallies from the device rounds.
+        valid = h != 0
+        t = tally_from_rounds(rounds, host)
+        backend._add_tally(Tally(
+            checks=int(valid.sum()),
+            over_limit=int((status[valid] == 1).sum()),
+            not_persisted=t.not_persisted,
+            cache_hits=t.cache_hits,
+        ))
+
+        # Split back per entry.
+        outs: List[Tuple[np.ndarray, ...]] = []
+        off = 0
+        for e in entries:
+            k = e.cols.n
+            outs.append((
+                status[off:off + k], out_lim[off:off + k],
+                remaining[off:off + k], reset[off:off + k],
+            ))
+            off += k
+        return outs
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+
+
+class _Entry:
+    __slots__ = ("cols", "is_greg", "greg_expire", "greg_duration", "fut")
+
+    def __init__(self, cols, is_greg, greg_expire, greg_duration, fut):
+        self.cols = cols
+        self.is_greg = is_greg
+        self.greg_expire = greg_expire
+        self.greg_duration = greg_duration
+        self.fut = fut
+
+
+def _build_rounds(values, rnd, lane, sh_all, n_rounds, n_shards, B):
+    """Scatter columnar values into fixed-shape DeviceBatch rounds.
+    Returns (rounds, order, bounds) — order/bounds group request indices
+    by round for the response gather."""
+    ok = np.flatnonzero(rnd >= 0)
+    order = ok[np.argsort(rnd[ok], kind="stable")]
+    bounds = np.searchsorted(rnd[order], np.arange(n_rounds + 1))
+    rounds: List[DeviceBatch] = []
+    for r_idx in range(n_rounds):
+        grid = _empty_batch((n_shards, B))
+        sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+        s_m, l_m = sh_all[sel], lane[sel]
+        for f, v in values.items():
+            getattr(grid, f)[s_m, l_m] = v[sel]
+        grid.active[s_m, l_m] = True
+        rounds.append(
+            grid if n_shards > 1 else DeviceBatch(*[a[0] for a in grid])
+        )
+    return rounds, order, bounds
+
+
+class _CascadePlan:
+    __slots__ = ("occ", "firsts", "groups", "inv")
+
+    def __init__(self, occ, firsts, groups, inv):
+        self.occ = occ          # bool[n]: occurrence is in a cascade group
+        self.firsts = firsts    # int[-]: first-occurrence index per group
+        self.groups = groups    # int[-]: group ids (into inv's codomain)
+        self.inv = inv          # int[n]: np.unique inverse (key group id)
+
+
+def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst):
+    """Pick duplicate-key groups the host cascade can serve exactly.
+
+    Eligible: >1 occurrence of a key where every occurrence has positive
+    hits, no RESET_REMAINING, no Gregorian duration, and identical
+    limit/duration/algorithm/burst.  The per-occurrence branch order of
+    the kernel (over-at-zero / exact / over-more / under) is then a pure
+    function of the running remaining, replayable on host from the read
+    lane's post-step `stored` value.  Anything else keeps the exact
+    round-per-occurrence machinery."""
+    uniq, first_idx, inv, counts = np.unique(
+        h, return_index=True, return_inverse=True, return_counts=True
+    )
+    dup = (counts > 1) & (uniq != 0)
+    if not dup.any():
+        return None
+    nb = len(uniq)
+    bad_occ = (hits <= 0) | reset_remaining | is_greg
+    grp_bad = np.bincount(
+        inv, weights=bad_occ.astype(np.float64), minlength=nb
+    ) > 0
+    same = np.ones(nb, dtype=bool)
+    for arr in (lim, dur, burst, algo.astype(np.int64)):
+        diff = arr != arr[first_idx][inv]
+        same &= np.bincount(
+            inv, weights=diff.astype(np.float64), minlength=nb
+        ) == 0
+    casc = dup & ~grp_bad & same
+    if not casc.any():
+        return None
+    return _CascadePlan(
+        occ=casc[inv],
+        firsts=first_idx[casc],
+        groups=np.flatnonzero(casc),
+        inv=inv,
+    )
+
+
+def _run_cascade(plan, h, hits, lim, dur, algo, burst,
+                 status, out_lim, remaining, reset, stored):
+    """Replay each cascade group's occurrences on host, writing their
+    responses in place, and build the effective write-back columns.
+
+    The replay is bit-exact against the kernel for eligible groups:
+    token (algorithms.go:162-195) and leaky (algorithms.go:395-426) share
+    the branch lattice over the running remaining, and leaky's float
+    fraction is invariant under integer-hit subtraction so the integer
+    `stored` seed suffices.  Two deliberate, documented divergences:
+    the table's sticky Status field holds the write-back's value rather
+    than the last occurrence's, and a fully-drained leaky group's expiry
+    refresh rides an over-limit touch lane."""
+    wb_h: List[int] = []
+    wb_hits: List[int] = []
+    wb_lim: List[int] = []
+    wb_dur: List[int] = []
+    wb_algo: List[int] = []
+    wb_burst: List[int] = []
+
+    # Occurrence lists per group, in arrival order, via one argsort.
+    order = np.argsort(plan.inv, kind="stable")
+    sorted_inv = plan.inv[order]
+    for g in plan.groups:
+        lo = np.searchsorted(sorted_inv, g)
+        hi = np.searchsorted(sorted_inv, g, side="right")
+        occ = order[lo:hi]
+        fi = occ[0]
+        lim0 = int(lim[fi])
+        algo0 = int(algo[fi])
+        reset0 = int(reset[fi])
+        r0 = int(stored[fi])
+        leaky = algo0 == 1
+        rate_i = int(float(dur[fi]) / float(lim0)) if (leaky and lim0) else 0
+        r = r0
+        for i in occ:
+            hc = int(hits[i])
+            if r == 0:
+                st, rr = 1, r
+            elif r == hc:
+                r = 0
+                st, rr = 0, 0
+            elif hc > r:
+                st, rr = 1, r
+            else:
+                r -= hc
+                st, rr = 0, r
+            status[i] = st
+            out_lim[i] = lim0
+            remaining[i] = rr
+            reset[i] = reset0 + (r0 - rr) * rate_i if leaky else reset0
+        eff = r0 - r
+        if eff > 0:
+            wb_hits.append(eff)
+        elif leaky:
+            # Over-limit "touch": refreshes the sliding expiry the way
+            # every nonzero-hit occurrence does, mutating nothing else.
+            wb_hits.append(int(burst[fi]) + 1)
+        else:
+            continue  # token state untouched by rejected hits
+        wb_h.append(int(h[fi]))
+        wb_lim.append(lim0)
+        wb_dur.append(int(dur[fi]))
+        wb_algo.append(algo0)
+        wb_burst.append(int(burst[fi]))
+    if not wb_h:
+        return None
+    return (
+        np.array(wb_h, dtype=np.int64),
+        np.array(wb_hits, dtype=np.int64),
+        np.array(wb_lim, dtype=np.int64),
+        np.array(wb_dur, dtype=np.int64),
+        np.array(wb_algo, dtype=np.int32),
+        np.array(wb_burst, dtype=np.int64),
+    )

@@ -143,6 +143,12 @@ class Metrics:
             "Occupied slots in the device table.",
             registry=r,
         )
+        self.global_cache_occupancy = Gauge(
+            "gubernator_tpu_global_cache_occupancy",
+            "Occupied slots in the GLOBAL replicated serving table "
+            "(mesh GlobalEngine; sized by global_cache_slots).",
+            registry=r,
+        )
 
     def render(self) -> bytes:
         """Text exposition for the /metrics endpoint."""

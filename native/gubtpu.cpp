@@ -190,4 +190,243 @@ int64_t gub_assign_rounds(const int64_t* hashes, const int32_t* shards,
   return n_rounds;
 }
 
+// ---------------------------------------------------------------------------
+// Protobuf wire codec for the GetRateLimits hot path.
+//
+// The python-protobuf parse/build of a 1000-item batch costs ~1ms each way —
+// more than the device step itself.  These two functions move the whole
+// request->columns and columns->response conversion to compiled code, the
+// analog of the reference's generated Go marshalers: the daemon's fast lane
+// hands the raw gRPC payload here and gets numpy columns back, and the
+// response bytes are emitted directly from the packed device output arrays.
+//
+// Wire schema (proto/gubernator.proto): GetRateLimitsReq{repeated
+// RateLimitReq requests = 1} with RateLimitReq fields name=1 unique_key=2
+// hits=3 limit=4 duration=5 algorithm=6 behavior=7 burst=8;
+// GetRateLimitsResp{repeated RateLimitResp responses = 1} with
+// status=1 limit=2 remaining=3 reset_time=4 error=5.  (peers.proto's
+// GetPeerRateLimits pair uses field 1 for the same item types, so the same
+// codec serves the peer-to-peer hot path.)
+// ---------------------------------------------------------------------------
+
+static inline bool get_varint(const uint8_t*& p, const uint8_t* end,
+                              uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+static inline bool skip_field(const uint8_t*& p, const uint8_t* end,
+                              uint32_t wire) {
+  uint64_t tmp;
+  switch (wire) {
+    case 0:
+      return get_varint(p, end, &tmp);
+    case 1:
+      if (end - p < 8) return false;
+      p += 8;
+      return true;
+    case 2:
+      if (!get_varint(p, end, &tmp) || (uint64_t)(end - p) < tmp)
+        return false;
+      p += tmp;
+      return true;
+    case 5:
+      if (end - p < 4) return false;
+      p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Count the repeated field-1 submessages of a GetRateLimitsReq (or
+// GetPeerRateLimitsReq) payload.  Returns -1 on malformed input.
+int64_t gub_count_reqs(const uint8_t* buf, int64_t len) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return -1;
+    if ((tag >> 3) == 1 && (tag & 7) == 2) {
+      uint64_t sz;
+      if (!get_varint(p, end, &sz) || (uint64_t)(end - p) < sz) return -1;
+      p += sz;
+      n++;
+    } else {
+      if (!skip_field(p, end, (uint32_t)(tag & 7))) return -1;
+    }
+  }
+  return n;
+}
+
+// Parse the payload into per-request columns.  err[i]: 0 ok, 1 empty
+// unique_key, 2 empty name (matching the service's validation order and
+// messages).  hash[i] = XXH64(name + "_" + unique_key) with 0 remapped to 1;
+// 0 on errored requests.  Returns the parsed count, or -1 on malformed
+// input (callers fall back to the python-protobuf path for the real error).
+int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
+                       int64_t* hash, int32_t* err, int64_t* hits,
+                       int64_t* limit, int64_t* duration, int32_t* algo,
+                       int64_t* behavior, int64_t* burst) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  std::vector<uint8_t> scratch;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return -1;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {
+      if (!skip_field(p, end, (uint32_t)(tag & 7))) return -1;
+      continue;
+    }
+    uint64_t sz;
+    if (!get_varint(p, end, &sz) || (uint64_t)(end - p) < sz) return -1;
+    if (n >= cap) return -1;
+    const uint8_t* q = p;
+    const uint8_t* qend = p + sz;
+    p = qend;
+
+    const uint8_t* name = nullptr;
+    uint64_t name_len = 0;
+    const uint8_t* key = nullptr;
+    uint64_t key_len = 0;
+    int64_t f_hits = 0, f_limit = 0, f_duration = 0, f_behavior = 0,
+            f_burst = 0;
+    int32_t f_algo = 0;
+    while (q < qend) {
+      uint64_t t;
+      if (!get_varint(q, qend, &t)) return -1;
+      uint32_t field = (uint32_t)(t >> 3);
+      uint32_t wire = (uint32_t)(t & 7);
+      if (wire == 2 && (field == 1 || field == 2)) {
+        uint64_t l;
+        if (!get_varint(q, qend, &l) || (uint64_t)(qend - q) < l) return -1;
+        if (field == 1) {
+          name = q;
+          name_len = l;
+        } else {
+          key = q;
+          key_len = l;
+        }
+        q += l;
+      } else if (wire == 0 && field >= 3 && field <= 8) {
+        uint64_t v;
+        if (!get_varint(q, qend, &v)) return -1;
+        switch (field) {
+          case 3: f_hits = (int64_t)v; break;
+          case 4: f_limit = (int64_t)v; break;
+          case 5: f_duration = (int64_t)v; break;
+          case 6: f_algo = (int32_t)v; break;
+          case 7: f_behavior = (int64_t)v; break;
+          case 8: f_burst = (int64_t)v; break;
+        }
+      } else {
+        if (!skip_field(q, qend, wire)) return -1;
+      }
+    }
+    hits[n] = f_hits;
+    limit[n] = f_limit;
+    duration[n] = f_duration;
+    algo[n] = f_algo;
+    behavior[n] = f_behavior;
+    burst[n] = f_burst;
+    if (key_len == 0) {
+      err[n] = 1;
+      hash[n] = 0;
+    } else if (name_len == 0) {
+      err[n] = 2;
+      hash[n] = 0;
+    } else {
+      err[n] = 0;
+      scratch.resize(name_len + 1 + key_len);
+      std::memcpy(scratch.data(), name, name_len);
+      scratch[name_len] = '_';
+      std::memcpy(scratch.data() + name_len + 1, key, key_len);
+      uint64_t h = xxh64(scratch.data(), scratch.size());
+      if (h == 0) h = 1;
+      hash[n] = (int64_t)h;
+    }
+    n++;
+  }
+  return n;
+}
+
+static inline int varint_size(uint64_t v) {
+  int s = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    s++;
+  }
+  return s;
+}
+
+static inline void put_varint(uint8_t*& w, uint64_t v) {
+  while (v >= 0x80) {
+    *w++ = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  *w++ = (uint8_t)v;
+}
+
+// Emit GetRateLimitsResp (or GetPeerRateLimitsResp) bytes from packed
+// response columns.  err_blob/err_off carry per-request error strings
+// (err_off[i]..err_off[i+1]); zero-length means no error.  Zero-valued
+// fields are omitted like proto3 requires.  Returns bytes written, or -1
+// if `cap` is too small.
+int64_t gub_serialize_resps(int64_t n, const int64_t* status,
+                            const int64_t* limit, const int64_t* remaining,
+                            const int64_t* reset_time,
+                            const uint8_t* err_blob, const int64_t* err_off,
+                            uint8_t* out, int64_t cap) {
+  uint8_t* w = out;
+  uint8_t* wend = out + cap;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t elen = (uint64_t)(err_off[i + 1] - err_off[i]);
+    size_t body = 0;
+    if (status[i]) body += 1 + varint_size((uint64_t)status[i]);
+    if (limit[i]) body += 1 + varint_size((uint64_t)limit[i]);
+    if (remaining[i]) body += 1 + varint_size((uint64_t)remaining[i]);
+    if (reset_time[i]) body += 1 + varint_size((uint64_t)reset_time[i]);
+    if (elen) body += 1 + varint_size(elen) + elen;
+    size_t total = 1 + varint_size(body) + body;
+    if ((size_t)(wend - w) < total) return -1;
+    *w++ = 0x0A;  // field 1, wire 2
+    put_varint(w, body);
+    if (status[i]) {
+      *w++ = 0x08;
+      put_varint(w, (uint64_t)status[i]);
+    }
+    if (limit[i]) {
+      *w++ = 0x10;
+      put_varint(w, (uint64_t)limit[i]);
+    }
+    if (remaining[i]) {
+      *w++ = 0x18;
+      put_varint(w, (uint64_t)remaining[i]);
+    }
+    if (reset_time[i]) {
+      *w++ = 0x20;
+      put_varint(w, (uint64_t)reset_time[i]);
+    }
+    if (elen) {
+      *w++ = 0x2A;
+      put_varint(w, elen);
+      std::memcpy(w, err_blob + err_off[i], elen);
+      w += elen;
+    }
+  }
+  return (int64_t)(w - out);
+}
+
 }  // extern "C"

@@ -1,0 +1,255 @@
+"""The compiled host fast lane (runtime/fastpath.py + native wire codec).
+
+Differential against the object path: identical responses for identical
+traffic, byte-for-byte wire compatibility, correct fallback for the
+behaviors the fast lane doesn't serve (VERDICT r2 #2; the reference's
+compiled hot loop is workers.go:249-314 + generated pb marshalers).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.core.config import DaemonConfig, DeviceConfig
+from gubernator_tpu.core.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.testing import Cluster
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def node():
+    """Single-node daemon — the client-path fast-lane configuration."""
+    c = Cluster.start(1)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def client(node):
+    cl = V1Client(node.addresses()[0])
+    yield cl
+    cl.close()
+
+
+def _fp(node):
+    return node.daemons[0].fastpath
+
+
+def test_fast_lane_serves_and_counts(node, client):
+    fp = _fp(node)
+    before = fp.served
+    for i, want in [(0, Status.UNDER_LIMIT), (1, Status.UNDER_LIMIT),
+                    (2, Status.OVER_LIMIT)]:
+        r = client.get_rate_limits([
+            RateLimitReq(
+                name="fp_over", unique_key="k", hits=1, limit=2,
+                duration=60_000,
+            )
+        ])[0]
+        assert r.error == ""
+        assert r.status == want, f"hit {i}"
+        assert r.remaining == max(0, 1 - i)
+        assert r.limit == 2
+    assert fp.served == before + 3  # actually took the compiled lane
+
+
+def test_fast_lane_duplicate_keys_serialize(node, client):
+    """Duplicate keys in one batch observe each other's effects in order
+    (the round-splitting contract, workers.go:182-186)."""
+    fp = _fp(node)
+    before = fp.served
+    reqs = [
+        RateLimitReq(name="fp_dup", unique_key="d", hits=2, limit=10,
+                     duration=60_000)
+        for _ in range(3)
+    ]
+    rs = client.get_rate_limits(reqs)
+    assert [r.remaining for r in rs] == [8, 6, 4]
+    assert fp.served == before + 3
+
+
+def test_fast_lane_validation_errors(node, client):
+    fp = _fp(node)
+    before = fp.served
+    rs = client.get_rate_limits([
+        RateLimitReq(name="", unique_key="x", hits=1, limit=5,
+                     duration=1000),
+        RateLimitReq(name="x", unique_key="", hits=1, limit=5,
+                     duration=1000),
+        RateLimitReq(name="fp_ok", unique_key="ok", hits=1, limit=5,
+                     duration=60_000),
+    ])
+    assert rs[0].error == "field 'namespace' cannot be empty"
+    assert rs[1].error == "field 'unique_key' cannot be empty"
+    assert rs[2].error == "" and rs[2].remaining == 4
+    assert fp.served == before + 3
+
+
+def test_fast_lane_leaky_and_gregorian(node, client):
+    fp = _fp(node)
+    before = fp.served
+    rs = client.get_rate_limits([
+        RateLimitReq(name="fp_leaky", unique_key="l", hits=1, limit=10,
+                     duration=60_000, algorithm=Algorithm.LEAKY_BUCKET,
+                     burst=5),
+        RateLimitReq(name="fp_greg", unique_key="g", hits=1, limit=100,
+                     duration=1,  # GregorianHours
+                     behavior=Behavior.DURATION_IS_GREGORIAN),
+        RateLimitReq(name="fp_greg", unique_key="bad", hits=1, limit=100,
+                     duration=99,
+                     behavior=Behavior.DURATION_IS_GREGORIAN),
+    ])
+    assert rs[0].error == "" and rs[0].remaining == 4  # burst capacity
+    assert rs[1].error == "" and rs[1].remaining == 99
+    assert rs[1].reset_time > 0
+    assert rs[2].error != ""  # invalid Gregorian interval reports per-lane
+    assert fp.served == before + 3
+
+
+def test_global_falls_back_to_object_path(node, client):
+    """GLOBAL behavior routes through the managers — the fast lane must
+    decline, and the response must still be correct."""
+    fp = _fp(node)
+    before_fb = fp.fallbacks
+    r = client.get_rate_limits([
+        RateLimitReq(name="fp_glob", unique_key="g", hits=1, limit=10,
+                     duration=60_000, behavior=Behavior.GLOBAL)
+    ])[0]
+    assert r.error == "" and r.remaining == 9
+    assert fp.fallbacks > before_fb
+
+
+def test_oversized_batch_rejected(node, client):
+    import grpc
+
+    reqs = [
+        RateLimitReq(name="fp_big", unique_key=f"k{i}", hits=1, limit=10,
+                     duration=60_000)
+        for i in range(1001)
+    ]
+    with pytest.raises(grpc.RpcError) as ei:
+        client.get_rate_limits(reqs)
+    assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+
+
+def test_fast_lane_on_mesh_backend():
+    """The fast lane routes by hash to mesh shards and serves from the
+    sharded step (the multi-chip daemon configuration)."""
+    c = Cluster.start(
+        1,
+        device=DeviceConfig(
+            num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+        ),
+    )
+    try:
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        reqs = [
+            RateLimitReq(name="fp_mesh", unique_key=f"m{i}", hits=1,
+                         limit=10, duration=60_000)
+            for i in range(100)
+        ]
+        r1 = cl.get_rate_limits(reqs)
+        assert all(x.error == "" for x in r1)
+        assert all(x.remaining == 9 for x in r1)
+        r2 = cl.get_rate_limits(reqs)
+        assert all(x.remaining == 8 for x in r2)
+        assert fp.served == 200
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_store_disables_fast_lane():
+    """A Store-attached daemon must keep every check on the SPI-honoring
+    object path."""
+    from gubernator_tpu.runtime.store import MockStore
+
+    store = MockStore()
+    conf = DaemonConfig()
+    conf.store = store
+    c = Cluster.start(1, conf_template=conf)
+    try:
+        cl = V1Client(c.addresses()[0])
+        r = cl.get_rate_limits([
+            RateLimitReq(name="fp_store", unique_key="s", hits=1, limit=5,
+                         duration=60_000)
+        ])[0]
+        assert r.error == "" and r.remaining == 4
+        assert _fp(c).served == 0
+        assert store.called["on_change"] == 1
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_fastpath_differential_duplicate_heavy(frozen_clock):
+    """Random duplicate-heavy streams through the compiled lane must be
+    bit-identical to the object path — including the host-cascade path for
+    hot keys and the round-machinery fallback for mixed-param groups
+    (the regression tier of functional_test.go:1106, fastpath edition)."""
+    import asyncio
+    import random
+
+    from gubernator_tpu.core.config import Config
+    from gubernator_tpu.net.grpc_api import reqs_from_pb
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    async def scenario():
+        dev = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
+        s_fast = Service(Config(device=dev), clock=frozen_clock)
+        s_ref = Service(Config(device=dev), clock=frozen_clock)
+        await s_fast.start()
+        await s_ref.start()
+        fp = FastPath(s_fast)
+        rng = random.Random(42)
+        for step in range(25):
+            n = rng.randint(1, 60)
+            reqs = []
+            for _ in range(n):
+                behavior = 0
+                if rng.random() < 0.05:
+                    behavior |= 8  # RESET_REMAINING
+                reqs.append(pb.RateLimitReq(
+                    name="diff",
+                    unique_key=f"d{rng.randint(0, 7)}",  # hot duplicates
+                    hits=rng.choice([0, 1, 1, 1, 2, 3, -1]),
+                    limit=rng.choice([20, 20, 20, 30]),
+                    duration=60_000,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 25]),
+                ))
+            payload = pb.GetRateLimitsReq(
+                requests=reqs
+            ).SerializeToString()
+            out = await fp.check_raw(payload, peer_rpc=False)
+            assert out is not None
+            got = pb.GetRateLimitsResp.FromString(out).responses
+            want = await s_ref.get_rate_limits(reqs_from_pb(reqs))
+            assert len(got) == len(reqs)
+            for j, (g, w) in enumerate(zip(got, want)):
+                assert g.error == w.error, (step, j)
+                assert g.status == int(w.status), (step, j)
+                assert g.limit == w.limit, (step, j)
+                assert g.remaining == w.remaining, (step, j)
+                assert g.reset_time == w.reset_time, (step, j)
+            frozen_clock.advance(rng.choice([0, 100, 5_000]))
+        assert fp.served > 0
+        await s_fast.close()
+        await s_ref.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -188,3 +188,72 @@ def test_batching_aggregates_into_one_rpc():
         await d.close()
 
     run(scenario())
+
+
+def test_provably_unsent_classification():
+    """Retry-safety must classify on either error field (detail wording
+    moves between details() and debug_error_string() across grpc-core
+    versions) and never mark mid-RPC failures retry-safe."""
+    import grpc
+
+    from gubernator_tpu.net.peer_client import provably_unsent
+
+    class FakeRpcError(Exception):
+        def __init__(self, code, details=None, debug=None):
+            self._c, self._d, self._dbg = code, details, debug
+
+        def code(self):
+            return self._c
+
+        def details(self):
+            return self._d
+
+        def debug_error_string(self):
+            return self._dbg
+
+    assert provably_unsent(PeerNotReadyError("shutdown"))
+    # Marker in details() (current grpc-core wording).
+    assert provably_unsent(FakeRpcError(
+        grpc.StatusCode.UNAVAILABLE,
+        details="failed to connect to all addresses",
+    ))
+    # Marker only in debug_error_string() (other versions put it there).
+    assert provably_unsent(FakeRpcError(
+        grpc.StatusCode.UNAVAILABLE,
+        details="unavailable",
+        debug='{"grpc_status":14,"description":"Connection refused"}',
+    ))
+    # Mid-RPC failures: the peer may have applied the batch.
+    assert not provably_unsent(FakeRpcError(
+        grpc.StatusCode.UNAVAILABLE, details="Socket closed"
+    ))
+    assert not provably_unsent(FakeRpcError(
+        grpc.StatusCode.DEADLINE_EXCEEDED, details="Deadline Exceeded"
+    ))
+    assert not provably_unsent(ValueError("not an rpc error"))
+
+
+def test_batcher_cancel_fails_dequeued_waiters():
+    """A cancellation while the batcher holds dequeued requests must fail
+    their futures, not orphan the callers (ADVICE r2)."""
+    from gubernator_tpu.core.config import BehaviorConfig
+
+    async def scenario():
+        pc = PeerClient(
+            PeerInfo(grpc_address="127.0.0.1:1"),
+            behavior=BehaviorConfig(batch_wait_s=30.0),
+        )
+        caller = asyncio.ensure_future(pc.get_peer_rate_limit(
+            RateLimitReq(name="a", unique_key="k", hits=1, limit=1,
+                         duration=1000)
+        ))
+        # Let the batcher dequeue the request into its window wait.
+        await asyncio.sleep(0.2)
+        assert not caller.done()
+        pc._batcher_task.cancel()
+        with pytest.raises(PeerNotReadyError):
+            await asyncio.wait_for(caller, timeout=2.0)
+        pc._shutdown = True
+        await pc.shutdown()
+
+    run(scenario())
