@@ -1,0 +1,77 @@
+# gubernator-tpu on AWS ECS with Cloud Map (DNS) peer discovery — the
+# analog of the reference's examples/aws-ecs-service-discovery-deployment.
+#
+# Peers find each other through an AWS Cloud Map private DNS namespace:
+# every task registers an A record under gubernator.<namespace>, and the
+# daemon's DNS discovery (GUBER_PEER_DISCOVERY_TYPE=dns) polls that name.
+# Adjust image/cpu/memory for your TPU-host-adjacent instance type; the
+# daemon itself is CPU-only when pointed at a remote JAX backend.
+
+variable "vpc_id" { type = string }
+variable "subnet_ids" { type = list(string) }
+variable "cluster_arn" { type = string }
+variable "image" {
+  type    = string
+  default = "ghcr.io/example/gubernator-tpu:latest"
+}
+
+resource "aws_service_discovery_private_dns_namespace" "guber" {
+  name = "guber.local"
+  vpc  = var.vpc_id
+}
+
+resource "aws_service_discovery_service" "guber" {
+  name = "gubernator"
+  dns_config {
+    namespace_id   = aws_service_discovery_private_dns_namespace.guber.id
+    routing_policy = "MULTIVALUE"
+    dns_records {
+      type = "A"
+      ttl  = 10
+    }
+  }
+  health_check_custom_config { failure_threshold = 1 }
+}
+
+resource "aws_ecs_task_definition" "guber" {
+  family                   = "gubernator-tpu"
+  network_mode             = "awsvpc"
+  requires_compatibilities = ["FARGATE"]
+  cpu                      = 1024
+  memory                   = 4096
+  container_definitions = jsonencode([{
+    name      = "gubernator-tpu"
+    image     = var.image
+    essential = true
+    portMappings = [
+      { containerPort = 1051, protocol = "tcp" }, # gRPC
+      { containerPort = 1050, protocol = "tcp" }, # HTTP/REST + /metrics
+    ]
+    environment = [
+      { name = "GUBER_GRPC_ADDRESS", value = "0.0.0.0:1051" },
+      { name = "GUBER_HTTP_ADDRESS", value = "0.0.0.0:1050" },
+      { name = "GUBER_PEER_DISCOVERY_TYPE", value = "dns" },
+      { name = "GUBER_DNS_FQDN", value = "gubernator.guber.local" },
+      { name = "GUBER_DNS_POLL_INTERVAL", value = "10" },
+    ]
+    healthCheck = {
+      command  = ["CMD-SHELL", "gubernator-tpu-healthcheck || exit 2"]
+      interval = 10
+      retries  = 3
+    }
+  }])
+}
+
+resource "aws_ecs_service" "guber" {
+  name            = "gubernator-tpu"
+  cluster         = var.cluster_arn
+  task_definition = aws_ecs_task_definition.guber.arn
+  desired_count   = 3
+  launch_type     = "FARGATE"
+  network_configuration {
+    subnets = var.subnet_ids
+  }
+  service_registries {
+    registry_arn = aws_service_discovery_service.guber.arn
+  }
+}

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # Defaults from reference config.go:115-131, 300-301, lrucache.go:63.
 DEFAULT_BATCH_TIMEOUT_S = 0.5
@@ -54,6 +54,11 @@ class DeviceConfig:
     batch_size: int = 1024
     num_shards: int = 1  # mesh axis size for the sharded table
     platform: Optional[str] = None  # None = jax default
+    # Compiled batch-shape tiers: a round whose active lanes fit a smaller
+    # tier ships that shape instead of the full batch_size array, so
+    # host<->device transfer (and small-batch latency) scales with traffic.
+    # None = (128, batch_size).  Each tier costs one XLA compile at warmup.
+    batch_tiers: Optional[Tuple[int, ...]] = None
     # GLOBAL replicated-serving cache table size (mesh GlobalEngine only).
     # None = num_slots, i.e. the engine DOUBLES the table HBM footprint;
     # size it to the expected GLOBAL working set (usually a small fraction
@@ -83,7 +88,14 @@ class DeviceConfig:
 class SketchTierConfig:
     """Approximate (count-min sketch) tier: limit names whose key
     cardinality outgrows exact slots (no reference analog — the reference
-    silently over-admits under cache pressure, lrucache.go:147-158)."""
+    silently over-admits under cache pressure, lrucache.go:147-158).
+
+    SEMANTICS CAVEAT: the sketch counts over tier-level tumbling windows of
+    `window_ms` — a request's own `duration` field is IGNORED for names
+    routed here (a shared sketch cannot keep per-key windows).  Configure
+    `window_ms` to the duration your sketch-tier limits expect; a request
+    whose duration differs silently gets window_ms semantics
+    (runtime/sketch_backend.py documents the mechanics)."""
 
     names: List[str] = field(default_factory=list)
     depth: int = 4
@@ -155,7 +167,10 @@ class TLSConfig:
     ca_key_file: str = ""
     cert_file: str = ""
     key_file: str = ""
-    client_auth: str = ""  # ""|request|require|verify
+    # ""|request|verify-if-given|require-any|require-and-verify
+    # (legacy "require"/"verify" == require-and-verify); see net/tls.py
+    # for the exact python mapping of the four Go modes.
+    client_auth: str = ""
     insecure_skip_verify: bool = False
 
 

@@ -1,16 +1,15 @@
-"""Gregorian calendar intervals + the one-shot interval ticker.
+"""Gregorian calendar intervals (reference interval.go:84-148).
 
-Mirrors reference interval.go:29-148.  Duration values 0-5 select a calendar
+The reference's one-shot ticker (interval.go:29-72) has no class here: its
+role is played by the asyncio window_flush_loop heartbeat
+(runtime/service.py).  Duration values 0-5 select a calendar
 interval; expiry is the END of the current interval (e.g. for Minutes, the
 last millisecond of the current minute).
 """
 from __future__ import annotations
 
-import asyncio
 import calendar
 from datetime import datetime, timedelta
-from typing import Optional
-
 GREGORIAN_MINUTES = 0
 GREGORIAN_HOURS = 1
 GREGORIAN_DAYS = 2
@@ -83,39 +82,3 @@ def gregorian_expiration(now: datetime, d: int) -> int:
         "behavior DURATION_IS_GREGORIAN is set; but `Duration` is not a valid "
         "gregorian interval"
     )
-
-
-class Interval:
-    """One-shot async ticker (reference interval.go:29-72).
-
-    `next()` arms the timer; `wait()` resolves one interval later.  Multiple
-    `next()` calls before the tick fires are coalesced.  This is the batching
-    heartbeat used by the peer batcher and the GLOBAL manager.
-    """
-
-    def __init__(self, delay_s: float) -> None:
-        self._delay = delay_s
-        self._armed = False
-        self._event = asyncio.Event()
-        self._task: Optional[asyncio.Task] = None
-
-    def next(self) -> None:
-        if self._armed:
-            return
-        self._armed = True
-        self._task = asyncio.get_running_loop().create_task(self._fire())
-
-    async def _fire(self) -> None:
-        await asyncio.sleep(self._delay)
-        self._event.set()
-
-    async def wait(self) -> None:
-        await self._event.wait()
-        self._event.clear()
-        self._armed = False
-
-    def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
-        self._armed = False

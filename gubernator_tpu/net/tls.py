@@ -11,9 +11,21 @@ config.go:338-368) for python gRPC + aiohttp:
   path) so TLS "just works" in dev clusters;
 - client-side credentials with optional insecure_skip_verify.
 
-gRPC python cannot express "request but don't require" client certs, so the
-four Go modes collapse onto require_client_auth True/False pairs — the
-verifying modes verify against the configured (or generated) CA.
+Client-auth mode mapping (reference config.go:348-362, tls.go:140-238):
+
+| Go mode                     | here              | gRPC / ssl behavior    |
+|-----------------------------|-------------------|------------------------|
+| request                     | "request"         | cert optional, VERIFIED
+|                             |                   | if presented (python
+|                             |                   | cannot skip verify)    |
+| verify-if-given             | "verify-if-given" | cert optional, verified
+|                             |                   | if presented (exact)   |
+| require-any                 | "require-any"     | cert required AND
+|                             |                   | verified (python cannot
+|                             |                   | require-without-verify)|
+| require-and-verify          | "require"/"verify"| cert required+verified |
+
+The two inexact rows are strictly STRICTER than Go's, never weaker.
 """
 from __future__ import annotations
 
@@ -25,6 +37,14 @@ from typing import Optional, Tuple
 import grpc
 
 from gubernator_tpu.core.config import TLSConfig
+
+# Client certs required (and verified — python offers no
+# require-without-verify): Go's RequireAnyClientCert and
+# RequireAndVerifyClientCert, plus the legacy spellings.
+REQUIRED_MODES = ("require", "verify", "require-any", "require-and-verify")
+# Client certs optional, verified when presented: Go's RequestClientCert
+# (strictly stricter here) and VerifyClientCertIfGiven (exact).
+OPTIONAL_MODES = ("request", "verify-if-given")
 
 
 @dataclass
@@ -38,10 +58,13 @@ class TLSBundle:
     insecure_skip_verify: bool = False
 
     def server_credentials(self) -> grpc.ServerCredentials:
-        require = self.client_auth in ("require", "verify")
+        require = self.client_auth in REQUIRED_MODES
+        optional = self.client_auth in OPTIONAL_MODES
         return grpc.ssl_server_credentials(
             [(self.key_pem, self.cert_pem)],
-            root_certificates=self.ca_pem if require else None,
+            root_certificates=(
+                self.ca_pem if (require or optional) else None
+            ),
             require_client_auth=require,
         )
 
@@ -54,22 +77,10 @@ class TLSBundle:
             certificate_chain=self.cert_pem,
         )
 
-    def client_ssl_context(self) -> ssl.SSLContext:
-        """aiohttp/HTTP-gateway client context."""
-        ctx = ssl.create_default_context(
-            cadata=self.ca_pem.decode()
-        )
-        if self.insecure_skip_verify:
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-        return ctx
-
-    def server_ssl_context(self) -> ssl.SSLContext:
-        """aiohttp/HTTP-gateway server context (needs temp files for
-        load_cert_chain)."""
+    def _load_own_cert(self, ctx: ssl.SSLContext) -> None:
+        """load_cert_chain needs files; round-trip the in-memory PEMs."""
         import tempfile
 
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
                 tempfile.NamedTemporaryFile(suffix=".pem") as kf:
             cf.write(self.cert_pem)
@@ -77,9 +88,31 @@ class TLSBundle:
             kf.write(self.key_pem)
             kf.flush()
             ctx.load_cert_chain(cf.name, kf.name)
-        if self.client_auth in ("require", "verify"):
+
+    def client_ssl_context(self) -> ssl.SSLContext:
+        """aiohttp/HTTP-gateway client context; presents this bundle's
+        cert so mTLS gateways (client_auth modes) accept the connection."""
+        ctx = ssl.create_default_context(
+            cadata=self.ca_pem.decode()
+        )
+        self._load_own_cert(ctx)
+        if self.insecure_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def server_ssl_context(self) -> ssl.SSLContext:
+        """aiohttp/HTTP-gateway server context."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._load_own_cert(ctx)
+        if self.client_auth in REQUIRED_MODES:
             ctx.load_verify_locations(cadata=self.ca_pem.decode())
             ctx.verify_mode = ssl.CERT_REQUIRED
+        elif self.client_auth in OPTIONAL_MODES:
+            # verify-if-given (tls.go VerifyClientCertIfGiven): a client
+            # may connect bare; a presented cert must chain to the CA.
+            ctx.load_verify_locations(cadata=self.ca_pem.decode())
+            ctx.verify_mode = ssl.CERT_OPTIONAL
         return ctx
 
 

@@ -580,3 +580,33 @@ def apply_batch_packed_impl(
 apply_batch_packed = jax.jit(
     apply_batch_packed_impl, static_argnames=("ways",), donate_argnums=(0,)
 )
+
+
+def unpack_batch_q(q) -> DeviceBatchJ:
+    """Device-side unpack of ONE int64[12, B] request array (row order =
+    DeviceBatch field order; bools/int32s travel widened as int64)."""
+    return DeviceBatchJ(
+        key_hash=q[0], hits=q[1], limit=q[2], duration=q[3],
+        algo=q[4].astype(jnp.int32), burst=q[5],
+        reset_remaining=q[6].astype(bool), is_greg=q[7].astype(bool),
+        greg_expire=q[8], greg_duration=q[9],
+        active=q[10].astype(bool), use_cached=q[11].astype(bool),
+    )
+
+
+def apply_batch_packed_q_impl(
+    table: SlotTable,
+    q: jax.Array,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[SlotTable, jax.Array]:
+    """Fully packed step: ONE int64[12, B] host->device transfer in, ONE
+    int64[7, B] transfer out.  Per-transfer link latency (remote-device
+    tunnels) makes the 12-arrays-in form 12x more expensive; this is the
+    single-device analog of the mesh path's pack_grid_batch."""
+    return apply_batch_packed_impl(table, unpack_batch_q(q), now, ways)
+
+
+apply_batch_packed_q = jax.jit(
+    apply_batch_packed_q_impl, static_argnames=("ways",), donate_argnums=(0,)
+)

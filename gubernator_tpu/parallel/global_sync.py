@@ -52,7 +52,7 @@ from gubernator_tpu.parallel.sharded import (
     pack_grid_batch,
     packed_grid_rounds_to_host,
 )
-from gubernator_tpu.runtime.backend import unmarshal_responses
+from gubernator_tpu.runtime.backend import tier_of, unmarshal_responses
 
 
 class DeltaGrid(NamedTuple):
@@ -299,8 +299,9 @@ class GlobalEngine:
         round_resps = []
         with self._lock:
             for db in packed.rounds:
+                t = tier_of(db.active, self.b._tiers)
                 batch = jax.device_put(
-                    pack_grid_batch(db), self.b._psharding
+                    pack_grid_batch(db)[:, :, :t], self.b._psharding
                 )
                 self.cache_table, resp = self._ingest(
                     self.cache_table, batch, now
@@ -488,6 +489,17 @@ class GlobalEngine:
             self.b.table, self.cache_table = self._sync_step(
                 self.b.table, self.cache_table, sharded, now
             )
+            # Ingest executables for the CACHE table geometry (the jit
+            # cache keys on table size, so the auth-table warmup doesn't
+            # cover a global_cache_slots-sized table) at every tier.
+            for t in self.b._tiers:
+                batch = jax.device_put(
+                    np.zeros((12, self.n, t), dtype=np.int64),
+                    self.b._psharding,
+                )
+                self.cache_table, _ = self._ingest(
+                    self.cache_table, batch, now
+                )
 
     # -- point reads (tests / HealthCheck) -------------------------------
     def _cache_bucket_offset(self, key: str, shard: int) -> int:

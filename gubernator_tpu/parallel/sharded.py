@@ -41,6 +41,8 @@ from gubernator_tpu.runtime.backend import (
     PersistenceHost,
     _row_to_item,
     probe_bucket,
+    resolve_tiers,
+    tier_of,
     unmarshal_responses,
 )
 
@@ -235,6 +237,9 @@ class MeshBackend(PersistenceHost):
         )
 
         self._step_packed = make_sharded_step_packed(self.mesh, cfg.ways)
+        # Batch-shape tiers (see DeviceConfig.batch_tiers): sparse rounds
+        # ship a sliced [12, n, t] block instead of the full batch shape.
+        self._tiers = resolve_tiers(cfg)
         # Batch input sharding: [12, n, B] split on the shard axis (dim 1).
         self._psharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._cached_store = make_sharded_row_op(
@@ -291,7 +296,10 @@ class MeshBackend(PersistenceHost):
                 self._seed_from_store(reqs, packed, now_ms)
             for db in packed.rounds:
                 # ONE sharded put for the whole batch, ONE packed readback.
-                batch = jax.device_put(pack_grid_batch(db), self._psharding)
+                t = tier_of(db.active, self._tiers)
+                batch = jax.device_put(
+                    pack_grid_batch(db)[:, :, :t], self._psharding
+                )
                 self.table, resp = self._step_packed(self.table, batch, now)
                 round_resps.append(resp)
             if self.store is not None:
@@ -301,13 +309,17 @@ class MeshBackend(PersistenceHost):
                     reqs, packed, use_cached
                 )
                 wt_seq = self._wt_ticket()
-        out, tally = unmarshal_responses(
-            len(reqs), packed.errors, packed.positions,
-            packed_grid_rounds_to_host(round_resps),
-        )
-        self._add_tally(tally)
-        if captured is not None:
-            self._deliver_write_through(captured, wt_seq)
+        try:
+            out, tally = unmarshal_responses(
+                len(reqs), packed.errors, packed.positions,
+                packed_grid_rounds_to_host(round_resps),
+            )
+            self._add_tally(tally)
+        finally:
+            # Redeem the ticket even if unmarshal fails (see
+            # DeviceBackend.check) — unredeemed tickets wedge delivery.
+            if captured is not None:
+                self._deliver_write_through(captured, wt_seq)
         return out
 
     def step_rounds(
@@ -323,7 +335,10 @@ class MeshBackend(PersistenceHost):
         round_resps = []
         with self._lock:
             for db in rounds:
-                batch = jax.device_put(pack_grid_batch(db), self._psharding)
+                t = tier_of(db.active, self._tiers)
+                batch = jax.device_put(
+                    pack_grid_batch(db)[:, :, :t], self._psharding
+                )
                 self.table, resp = self._step_packed(self.table, batch, now)
                 round_resps.append(resp)
         host = packed_grid_rounds_to_host(round_resps)
@@ -346,6 +361,15 @@ class MeshBackend(PersistenceHost):
         )
         now = np.int64(self.clock.millisecond_now())
         with self._lock:
+            # Compile the sharded step at EVERY batch tier.
+            for t in self._tiers:
+                batch = jax.device_put(
+                    np.zeros(
+                        (12, self.cfg.num_shards, t), dtype=np.int64
+                    ),
+                    self._psharding,
+                )
+                self.table, resp = self._step_packed(self.table, batch, now)
             for db in packed.rounds:
                 batch = jax.device_put(pack_grid_batch(db), self._psharding)
                 self.table, resp = self._step_packed(self.table, batch, now)

@@ -36,12 +36,42 @@ from gubernator_tpu.ops.state import SlotTable, init_table, table_to_host
 from gubernator_tpu.ops.step import (
     BucketRows,
     CachedRows,
-    DeviceBatchJ,
-    apply_batch_packed,
+    apply_batch_packed_q,
     load_rows,
     probe_batch,
     store_cached_rows,
 )
+
+
+def pack_batch_q(db) -> np.ndarray:
+    """Stack a [B] DeviceBatch into one int64[12, B] host array (single
+    host->device transfer; bools/int32 widen)."""
+    arrs = [np.asarray(a) for a in db]
+    q = np.empty((len(arrs),) + arrs[0].shape, dtype=np.int64)
+    for i, a in enumerate(arrs):
+        q[i] = a
+    return q
+
+
+def resolve_tiers(cfg) -> tuple:
+    """Sorted compiled batch tiers; batch_size is ALWAYS included so
+    tier_of's fallback never truncates a full round."""
+    tiers = cfg.batch_tiers or (128, cfg.batch_size)
+    return tuple(sorted(
+        {min(t, cfg.batch_size) for t in tiers} | {cfg.batch_size}
+    ))
+
+
+def tier_of(active: np.ndarray, tiers) -> int:
+    """Smallest compiled batch tier that holds this round's active lanes
+    (the packer fills lanes contiguously from 0 per shard, so the max
+    per-shard count bounds the highest used lane).  `active` is [B] or
+    [n_shards, B]."""
+    occ = int(np.asarray(active).sum(-1).max())
+    for t in tiers:
+        if occ <= t:
+            return t
+    return tiers[-1]
 
 
 def _h64s(hashes: Sequence[int]) -> np.ndarray:
@@ -250,9 +280,15 @@ class DeviceBackend(PersistenceHost):
             self._device = jax.devices()[0]
         with jax.default_device(self._device):
             self.table: SlotTable = init_table(self.cfg.num_slots)
-        self._step_packed = functools.partial(
-            apply_batch_packed, ways=self.cfg.ways
+        self._step_packed_q = functools.partial(
+            apply_batch_packed_q, ways=self.cfg.ways
         )
+        # Batch-shape tiers: a round with few active lanes rides a small
+        # compiled shape instead of shipping the full [12, B] array — the
+        # transfer (and on slow links, the E2E latency) scales with the
+        # traffic, not the configured max batch.  batch_size is always a
+        # tier so a full round can never be truncated.
+        self._tiers = resolve_tiers(self.cfg)
         self._load_rows = functools.partial(load_rows, ways=self.cfg.ways)
         self._probe = functools.partial(probe_batch, ways=self.cfg.ways)
         # Module-level jits (apply_batch_packed/load_rows/probe_batch/
@@ -325,8 +361,9 @@ class DeviceBackend(PersistenceHost):
 
             with device_step_annotation():
                 for db in packed.rounds:
-                    self.table, packed_resp = self._step_packed(
-                        self.table, _to_device(db), np.int64(now)
+                    t = tier_of(db.active, self._tiers)
+                    self.table, packed_resp = self._step_packed_q(
+                        self.table, pack_batch_q(db)[:, :t], np.int64(now)
                     )
                     round_resps.append(packed_resp)
             if self.store is not None:
@@ -336,19 +373,24 @@ class DeviceBackend(PersistenceHost):
                     reqs, packed, use_cached
                 )
                 wt_seq = self._wt_ticket()
-        if self.metrics is not None:
-            self.metrics.device_step_duration.observe(
-                time.monotonic() - t_start
+        try:
+            if self.metrics is not None:
+                self.metrics.device_step_duration.observe(
+                    time.monotonic() - t_start
+                )
+                self.metrics.pool_queue_length.observe(len(reqs))
+            # One packed sync per round (one transfer instead of six).
+            out, tally = unmarshal_responses(
+                len(reqs), packed.errors, packed.positions,
+                packed_rounds_to_host(round_resps),
             )
-            self.metrics.pool_queue_length.observe(len(reqs))
-        # One packed sync per round (one transfer instead of six).
-        out, tally = unmarshal_responses(
-            len(reqs), packed.errors, packed.positions,
-            packed_rounds_to_host(round_resps),
-        )
-        self._add_tally(tally)
-        if captured is not None:
-            self._deliver_write_through(captured, wt_seq)
+            self._add_tally(tally)
+        finally:
+            # The ticket MUST be redeemed even if unmarshal fails, or
+            # every later delivery wedges in cond.wait (the step itself
+            # already happened, so delivering the capture is correct).
+            if captured is not None:
+                self._deliver_write_through(captured, wt_seq)
         return out
 
     def step_rounds(
@@ -366,8 +408,9 @@ class DeviceBackend(PersistenceHost):
         t_start = time.monotonic()
         with self._lock:
             for db in rounds:
-                self.table, packed_resp = self._step_packed(
-                    self.table, _to_device(db), now
+                t = tier_of(db.active, self._tiers)
+                self.table, packed_resp = self._step_packed_q(
+                    self.table, pack_batch_q(db)[:, :t], now
                 )
                 round_resps.append(packed_resp)
         if self.metrics is not None:
@@ -406,11 +449,19 @@ class DeviceBackend(PersistenceHost):
             self.clock,
         )
         with self._lock:
-            # Compile the packed step — check()'s actual hot path — so the
-            # first client request never pays the cold XLA compile.
+            # Compile the packed step at EVERY batch tier — check()'s
+            # actual hot path — so no client request ever pays a cold XLA
+            # compile.
+            for t in self._tiers:
+                self.table, resp = self._step_packed_q(
+                    self.table,
+                    np.zeros((12, t), dtype=np.int64),
+                    now,
+                )
             for db in packed.rounds:
-                self.table, resp = self._step_packed(
-                    self.table, _to_device(db), now
+                t = tier_of(db.active, self._tiers)
+                self.table, resp = self._step_packed_q(
+                    self.table, pack_batch_q(db)[:, :t], now
                 )
             # Fixed-shape probe executable (store seeding / bulk reads).
             self._probe(
@@ -629,10 +680,14 @@ def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
 
 def tally_from_rounds(rounds, round_host) -> "Tally":
     """Vectorized Tally over packed rounds (active lanes only) — the
-    columnar analog of unmarshal_responses' per-request counting."""
+    columnar analog of unmarshal_responses' per-request counting.
+
+    Host arrays may be tier-sliced narrower than the round's [.., B]
+    masks; lanes beyond the tier are inactive by construction, so the
+    mask is sliced to match."""
     checks = over = notp = hits = 0
     for db, h in zip(rounds, round_host):
-        act = np.asarray(db.active)
+        act = np.asarray(db.active)[..., : h["status"].shape[-1]]
         checks += int(act.sum())
         over += int(((h["status"] == 1) & act).sum())
         notp += int(((h["persisted"] == 0) & act).sum())
@@ -706,10 +761,6 @@ def probe_bucket(
                 return None
             return _row_to_item(rows, w, key)
     return None
-
-
-def _to_device(db: DeviceBatch) -> DeviceBatchJ:
-    return DeviceBatchJ(*[np.asarray(a) for a in db])
 
 
 def _row_to_item(snap: Dict[str, np.ndarray], s: int, key: str) -> CacheItem:

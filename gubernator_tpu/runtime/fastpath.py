@@ -218,6 +218,14 @@ class FastPath:
                 outs = await loop.run_in_executor(
                     self.s._dev_executor, lambda: self._process(entries)
                 )
+            except asyncio.CancelledError:
+                # Shutdown mid-step: fail the dequeued entries instead of
+                # orphaning their awaiting handlers.
+                err = RuntimeError("fastpath closed")
+                for en in entries:
+                    if not en.fut.done():
+                        en.fut.set_exception(err)
+                raise
             except Exception as e:  # noqa: BLE001
                 for en in entries:
                     if not en.fut.done():
@@ -377,6 +385,11 @@ class FastPath:
             self._task.cancel()
             await asyncio.gather(self._task, return_exceptions=True)
             self._task = None
+        # Entries still queued (never dequeued by _run) must fail too.
+        while not self._queue.empty():
+            en = self._queue.get_nowait()
+            if not en.fut.done():
+                en.fut.set_exception(RuntimeError("fastpath closed"))
 
 
 class _Entry:
@@ -493,37 +506,55 @@ def _run_cascade(plan, h, hits, lim, dur, algo, burst,
         r0 = int(stored[fi])
         leaky = algo0 == 1
         rate_i = int(float(dur[fi]) / float(lim0)) if (leaky and lim0) else 0
+        # Token status is STICKY: under/exact occurrences report the
+        # STORED status (te_resp_status = s_status in the kernel), which
+        # only flips to OVER on an over-at-zero hit.  The read lane's
+        # response status IS the stored status.  Leaky reports fresh.
+        st0 = int(status[fi])
+        flip = False  # an over-at-zero occurred (token stored -> OVER)
         r = r0
         for i in occ:
             hc = int(hits[i])
             if r == 0:
+                if not leaky and not flip:
+                    flip = True  # sticky stored-status transition
+                    st0 = 1
                 st, rr = 1, r
             elif r == hc:
                 r = 0
-                st, rr = 0, 0
+                st, rr = (0 if leaky else st0), 0
             elif hc > r:
                 st, rr = 1, r
             else:
                 r -= hc
-                st, rr = 0, r
+                st, rr = (0 if leaky else st0), r
             status[i] = st
             out_lim[i] = lim0
             remaining[i] = rr
             reset[i] = reset0 + (r0 - rr) * rate_i if leaky else reset0
+
+        def wb_lane(h_val: int) -> None:
+            wb_h.append(int(h[fi]))
+            wb_hits.append(h_val)
+            wb_lim.append(lim0)
+            wb_dur.append(int(dur[fi]))
+            wb_algo.append(algo0)
+            wb_burst.append(int(burst[fi]))
+
         eff = r0 - r
         if eff > 0:
-            wb_hits.append(eff)
+            wb_lane(eff)
         elif leaky:
             # Over-limit "touch": refreshes the sliding expiry the way
             # every nonzero-hit occurrence does, mutating nothing else.
-            wb_hits.append(int(burst[fi]) + 1)
-        else:
-            continue  # token state untouched by rejected hits
-        wb_h.append(int(h[fi]))
-        wb_lim.append(lim0)
-        wb_dur.append(int(dur[fi]))
-        wb_algo.append(algo0)
-        wb_burst.append(int(burst[fi]))
+            wb_lane(int(burst[fi]) + 1)
+        if flip:
+            # Reproduce the stored-status flip on device: after the eff
+            # lane drained the bucket to 0, one more hit is over-at-zero
+            # — it stores OVER and mutates nothing else (a later batch's
+            # under-branch response reports this stored status, so
+            # skipping it would diverge from the object path).
+            wb_lane(1)
     if not wb_h:
         return None
     return (

@@ -1,0 +1,272 @@
+"""k8s + etcd discovery pools driven by FAKE clients (VERDICT r2 #4).
+
+The real clients aren't in this image, so the pools are import-gated;
+these tests inject fake `kubernetes` / `etcd3` modules via sys.modules and
+exercise the actual pool logic: endpoint/pod churn, watch events, lease
+expiry + re-register, and teardown (reference etcd.go:110-316,
+kubernetes.go:114-244).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import types
+from dataclasses import asdict
+
+from gubernator_tpu.core.types import PeerInfo
+
+NS = types.SimpleNamespace
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# --------------------------------------------------------------------------
+# kubernetes
+# --------------------------------------------------------------------------
+
+def _ep(*ips):
+    return NS(subsets=[NS(addresses=[NS(ip=ip) for ip in ips])])
+
+
+def _pod(ip, ready=True):
+    return NS(status=NS(
+        pod_ip=ip,
+        conditions=[NS(type="Ready", status="True" if ready else "False")],
+    ))
+
+
+def _fake_kubernetes(state):
+    mod = types.ModuleType("kubernetes")
+    mod.config = NS(load_incluster_config=lambda: None)
+
+    class CoreV1Api:
+        def list_namespaced_endpoints(self, ns, label_selector=""):
+            state["calls"].append(("endpoints", ns, label_selector))
+            return NS(items=state["endpoints"])
+
+        def list_namespaced_pod(self, ns, label_selector=""):
+            state["calls"].append(("pods", ns, label_selector))
+            return NS(items=state["pods"])
+
+    mod.client = NS(CoreV1Api=CoreV1Api)
+    return mod
+
+
+def test_k8s_endpoints_churn(monkeypatch):
+    state = {
+        "endpoints": [_ep("10.0.0.1", "10.0.0.2")],
+        "pods": [],
+        "calls": [],
+    }
+    monkeypatch.setitem(sys.modules, "kubernetes", _fake_kubernetes(state))
+    from gubernator_tpu.discovery.k8s import K8sPool
+
+    updates = []
+
+    async def scenario():
+        pool = K8sPool(
+            updates.append,
+            namespace="guber",
+            selector="app=gubernator",
+            pod_ip="10.0.0.2",
+            poll_interval_s=0.02,
+        )
+        await pool.start()
+        assert updates[-1] == [
+            PeerInfo(grpc_address="10.0.0.1:81", http_address="10.0.0.1:80"),
+            PeerInfo(grpc_address="10.0.0.2:81", http_address="10.0.0.2:80",
+                     is_owner=True),
+        ]
+        assert state["calls"][0] == ("endpoints", "guber", "app=gubernator")
+        # Churn: one endpoint leaves, one joins; next poll publishes it.
+        state["endpoints"] = [_ep("10.0.0.2", "10.0.0.3")]
+        await asyncio.sleep(0.1)
+        assert [p.grpc_address for p in updates[-1]] == [
+            "10.0.0.2:81", "10.0.0.3:81"
+        ]
+        # A failing list keeps the last peer set instead of wiping it.
+        state["endpoints"] = None  # iteration raises TypeError in the pool
+        n = len(updates)
+        await asyncio.sleep(0.06)
+        assert all(u == updates[n - 1] for u in updates[n:] or [updates[-1]])
+        await pool.close()
+
+    run(scenario())
+
+
+def test_k8s_pods_mechanism_ready_filter(monkeypatch):
+    state = {
+        "endpoints": [],
+        "pods": [
+            _pod("10.1.0.1", ready=True),
+            _pod("10.1.0.2", ready=False),  # not Ready -> excluded
+            _pod(None, ready=True),         # no IP yet -> excluded
+        ],
+        "calls": [],
+    }
+    monkeypatch.setitem(sys.modules, "kubernetes", _fake_kubernetes(state))
+    from gubernator_tpu.discovery.k8s import K8sPool
+
+    updates = []
+
+    async def scenario():
+        pool = K8sPool(
+            updates.append, mechanism="pods", poll_interval_s=5.0
+        )
+        await pool.start()
+        assert [p.grpc_address for p in updates[-1]] == ["10.1.0.1:81"]
+        await pool.close()
+
+    run(scenario())
+
+
+# --------------------------------------------------------------------------
+# etcd
+# --------------------------------------------------------------------------
+
+class PutEvent:
+    def __init__(self, key: str, value: bytes) -> None:
+        self.key = key.encode()
+        self.value = value
+
+
+class DeleteEvent:
+    def __init__(self, key: str) -> None:
+        self.key = key.encode()
+        self.value = b""
+
+
+class _FakeLease:
+    def __init__(self, etcd) -> None:
+        self.etcd = etcd
+        self.revoked = False
+
+    def refresh(self):
+        return iter([NS(TTL=self.etcd.refresh_ttl)])
+
+    def revoke(self) -> None:
+        self.revoked = True
+
+
+class _FakeEtcd:
+    def __init__(self) -> None:
+        self.kv = {}
+        self.watchers = []
+        self.puts = 0
+        self.refresh_ttl = 30
+        self.leases = []
+        self.cancelled_watches = []
+
+    def lease(self, ttl):
+        lease = _FakeLease(self)
+        self.leases.append(lease)
+        return lease
+
+    def put(self, key, value, lease=None):
+        data = value.encode() if isinstance(value, str) else value
+        self.kv[key] = data
+        self.puts += 1
+        self._fire([PutEvent(key, data)])
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+        self._fire([DeleteEvent(key)])
+
+    def get_prefix(self, prefix):
+        return [
+            (v, NS(key=k.encode()))
+            for k, v in sorted(self.kv.items())
+            if k.startswith(prefix)
+        ]
+
+    def add_watch_prefix_callback(self, prefix, cb):
+        self.watchers.append((prefix, cb))
+        return len(self.watchers)
+
+    def cancel_watch(self, wid):
+        self.cancelled_watches.append(wid)
+
+    def _fire(self, events) -> None:
+        for _, cb in self.watchers:
+            cb(NS(events=events))
+
+    # test helper: a REMOTE node's registration arriving via watch
+    def remote_put(self, key, info: PeerInfo) -> None:
+        self.put(key, json.dumps(asdict(info)))
+
+
+def _fake_etcd3(fake):
+    mod = types.ModuleType("etcd3")
+    mod.client = lambda host, port: fake
+    return mod
+
+
+def test_etcd_register_watch_churn_teardown(monkeypatch):
+    fake = _FakeEtcd()
+    monkeypatch.setitem(sys.modules, "etcd3", _fake_etcd3(fake))
+    from gubernator_tpu.discovery import etcd as etcd_mod
+
+    updates = []
+    me = PeerInfo(grpc_address="10.2.0.1:81", http_address="10.2.0.1:80")
+
+    async def scenario():
+        pool = etcd_mod.EtcdPool(
+            updates.append, me, endpoints="etcd.example:2379"
+        )
+        await pool.start()
+        # Self-registration is in the store under the prefix, leased.
+        key = "/gubernator/peers/10.2.0.1:81"
+        assert key in fake.kv
+        assert fake.leases and not fake.leases[0].revoked
+        assert [p.grpc_address for p in updates[-1]] == ["10.2.0.1:81"]
+        assert updates[-1][0].is_owner
+
+        # A remote node joins -> watch event -> peer list grows.
+        fake.remote_put(
+            "/gubernator/peers/10.2.0.2:81",
+            PeerInfo(grpc_address="10.2.0.2:81"),
+        )
+        assert [p.grpc_address for p in updates[-1]] == [
+            "10.2.0.1:81", "10.2.0.2:81"
+        ]
+        assert not updates[-1][1].is_owner
+
+        # It leaves (lease expiry deletes its key) -> removed.
+        fake.delete("/gubernator/peers/10.2.0.2:81")
+        assert [p.grpc_address for p in updates[-1]] == ["10.2.0.1:81"]
+
+        # Teardown: watch cancelled, own key deleted, lease revoked.
+        await pool.close()
+        assert fake.cancelled_watches == [1]
+        assert key not in fake.kv
+        assert fake.leases[0].revoked
+
+    run(scenario())
+
+
+def test_etcd_lease_expiry_reregisters(monkeypatch):
+    fake = _FakeEtcd()
+    monkeypatch.setitem(sys.modules, "etcd3", _fake_etcd3(fake))
+    from gubernator_tpu.discovery import etcd as etcd_mod
+
+    # Shrink the 30s lease so the keepalive loop ticks inside the test.
+    monkeypatch.setattr(etcd_mod, "LEASE_TTL_S", 0.15)
+    me = PeerInfo(grpc_address="10.3.0.1:81")
+
+    async def scenario():
+        pool = etcd_mod.EtcdPool(lambda ps: None, me)
+        await pool.start()
+        puts_before = fake.puts
+        # Lease reports TTL=0 (lost server-side) -> pool must re-register
+        # with a fresh lease (etcd.go:262-313).
+        fake.refresh_ttl = 0
+        await asyncio.sleep(0.3)
+        assert fake.puts > puts_before
+        assert len(fake.leases) > 1
+        fake.refresh_ttl = 30  # healthy again; no further churn needed
+        await pool.close()
+
+    run(scenario())

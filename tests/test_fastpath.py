@@ -253,3 +253,49 @@ def test_fastpath_differential_duplicate_heavy(frozen_clock):
         await s_ref.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_fastpath_sticky_token_status(frozen_clock):
+    """The token stored status is STICKY (te_resp_status = s_status):
+    after an over-at-zero, a limit raise makes under-branch responses
+    report OVER until reset — the cascade and its write-back must
+    reproduce this across batches exactly like the object path."""
+    import asyncio
+
+    from gubernator_tpu.core.config import Config
+    from gubernator_tpu.net.grpc_api import reqs_from_pb
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    async def scenario():
+        dev = DeviceConfig(num_slots=1024, ways=8, batch_size=64)
+        s_fast = Service(Config(device=dev), clock=frozen_clock)
+        s_ref = Service(Config(device=dev), clock=frozen_clock)
+        await s_fast.start()
+        await s_ref.start()
+        fp = FastPath(s_fast)
+
+        def batch(limit, hits, n):
+            return [
+                pb.RateLimitReq(name="sticky", unique_key="k", hits=hits,
+                                limit=limit, duration=60_000)
+                for _ in range(n)
+            ]
+
+        # Batch 1: drain r0=2 with 3 duplicate hits -> the 3rd is
+        # over-at-zero and flips the stored status.
+        # Batch 2: raise the limit; under-branch responses must report the
+        # sticky OVER on both paths.
+        for reqs in [batch(2, 1, 3), batch(4, 1, 2)]:
+            payload = pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+            out = await fp.check_raw(payload, peer_rpc=False)
+            got = pb.GetRateLimitsResp.FromString(out).responses
+            want = await s_ref.get_rate_limits(reqs_from_pb(reqs))
+            for j, (g, w) in enumerate(zip(got, want)):
+                assert g.status == int(w.status), j
+                assert g.remaining == w.remaining, j
+        await s_fast.close()
+        await s_ref.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -115,3 +115,73 @@ def test_tls_cluster_forwarding():
 
     owners = asyncio.new_event_loop().run_until_complete(scenario())
     assert len(owners) == 2, f"expected both peers serving, got {owners}"
+
+
+def test_https_gateway_client_auth():
+    """HTTPS gateway client-auth modes (tls_test.go:235-343): a
+    require-and-verify gateway rejects bare clients and accepts
+    CA-signed certs; verify-if-given accepts both."""
+    import json
+    import ssl
+
+    import aiohttp
+
+    ca_pem, ca_key_pem, _, _ = generate_auto_tls()
+    with tempfile.NamedTemporaryFile(suffix=".pem", delete=False) as caf, \
+            tempfile.NamedTemporaryFile(
+                suffix=".pem", delete=False
+            ) as cakf:
+        caf.write(ca_pem)
+        cakf.write(ca_key_pem)
+    shared = dict(ca_file=caf.name, ca_key_file=cakf.name)
+    # A client identity signed by the same CA.
+    client_bundle = setup_tls(TLSConfig(**shared))
+
+    def bare_ctx() -> ssl.SSLContext:
+        return ssl.create_default_context(cadata=ca_pem.decode())
+
+    body = json.dumps({"requests": [{
+        "name": "tls_http", "unique_key": "k", "hits": 1, "limit": 5,
+        "duration": 60000,
+    }]})
+
+    async def roundtrip(http_addr: str, ctx: ssl.SSLContext):
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"https://{http_addr}/v1/GetRateLimits",
+                data=body, ssl=ctx,
+            ) as resp:
+                return await resp.json()
+
+    async def scenario(client_auth: str, with_cert: bool, expect_ok: bool):
+        d = Daemon(DaemonConfig(
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="127.0.0.1:0",
+            behaviors=fast_test_behaviors(),
+            device=DEV,
+            tls=TLSConfig(client_auth=client_auth, **shared),
+        ))
+        await d.start()
+        try:
+            ctx = (
+                client_bundle.client_ssl_context() if with_cert
+                else bare_ctx()
+            )
+            if expect_ok:
+                out = await roundtrip(d.http_address, ctx)
+                assert out["responses"][0]["remaining"] == "4"
+            else:
+                with pytest.raises(aiohttp.ClientError):
+                    await roundtrip(d.http_address, ctx)
+        finally:
+            await d.close()
+
+    for client_auth, with_cert, expect_ok in [
+        ("require-and-verify", True, True),
+        ("require-and-verify", False, False),
+        ("verify-if-given", False, True),
+        ("verify-if-given", True, True),
+    ]:
+        asyncio.new_event_loop().run_until_complete(
+            scenario(client_auth, with_cert, expect_ok)
+        )
