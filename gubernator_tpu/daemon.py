@@ -311,14 +311,27 @@ class Daemon:
         except json_format.ParseError as e:
             return web.json_response({"error": str(e)}, status=400)
         try:
-            resps = await self.service.get_rate_limits(
-                grpc_api.reqs_from_pb(msg.requests)
-            )
+            out = None
+            if self.fastpath is not None:
+                # Ride the compiled lane: same serialized device pipeline
+                # as gRPC traffic, so REST and gRPC checks of one key
+                # never interleave mid-cascade.
+                raw = await self.fastpath.check_raw(
+                    msg.SerializeToString(), peer_rpc=False
+                )
+                if raw is not None:
+                    out = pb.GetRateLimitsResp.FromString(raw)
+            if out is None:
+                resps = await self.service.get_rate_limits(
+                    grpc_api.reqs_from_pb(msg.requests)
+                )
+                out = pb.GetRateLimitsResp(
+                    responses=grpc_api.resps_to_pb(resps)
+                )
         except ApiError as e:
             return web.json_response(
                 {"error": str(e), "code": e.code}, status=400
             )
-        out = pb.GetRateLimitsResp(responses=grpc_api.resps_to_pb(resps))
         return web.Response(
             text=json_format.MessageToJson(
                 out,

@@ -15,17 +15,22 @@ Client-auth mode mapping (reference config.go:348-362, tls.go:140-238):
 
 | Go mode                     | here              | gRPC / ssl behavior    |
 |-----------------------------|-------------------|------------------------|
-| request                     | "request"         | cert optional, VERIFIED
-|                             |                   | if presented (python
-|                             |                   | cannot skip verify)    |
-| verify-if-given             | "verify-if-given" | cert optional, verified
-|                             |                   | if presented (exact)   |
+| request                     | "request"         | HTTPS gateway: cert
+|                             |                   | optional, verified if
+|                             |                   | presented; gRPC: not
+|                             |                   | requested (see below)  |
+| verify-if-given             | "verify-if-given" | same as "request"      |
 | require-any                 | "require-any"     | cert required AND
 |                             |                   | verified (python cannot
 |                             |                   | require-without-verify)|
 | require-and-verify          | "require"/"verify"| cert required+verified |
 
-The two inexact rows are strictly STRICTER than Go's, never weaker.
+The required rows are exact or strictly STRICTER than Go's.  The
+optional rows are exact on the HTTPS gateway (ssl.CERT_OPTIONAL) but
+grpc-python's credentials API has no request-without-require option, so
+on the gRPC listener optional modes cannot request a cert at all —
+setup_tls logs a warning; use a required mode when gRPC-side client
+identity matters.
 """
 from __future__ import annotations
 
@@ -58,13 +63,14 @@ class TLSBundle:
     insecure_skip_verify: bool = False
 
     def server_credentials(self) -> grpc.ServerCredentials:
+        # Optional modes intentionally pass NO roots: grpc maps
+        # require_client_auth=False to DONT_REQUEST_CLIENT_CERTIFICATE,
+        # so roots would be inert and imply verification that never
+        # happens (the HTTPS gateway implements the optional modes).
         require = self.client_auth in REQUIRED_MODES
-        optional = self.client_auth in OPTIONAL_MODES
         return grpc.ssl_server_credentials(
             [(self.key_pem, self.cert_pem)],
-            root_certificates=(
-                self.ca_pem if (require or optional) else None
-            ),
+            root_certificates=self.ca_pem if require else None,
             require_client_auth=require,
         )
 
@@ -131,6 +137,15 @@ def setup_tls(
     """
     if cfg is None:
         return None
+    if cfg.client_auth in OPTIONAL_MODES:
+        import logging
+
+        logging.getLogger("gubernator_tpu.tls").warning(
+            "client_auth=%r verifies presented certs on the HTTPS gateway "
+            "only; grpc-python cannot request-without-require, so the gRPC "
+            "listener will not ask clients for certificates",
+            cfg.client_auth,
+        )
     if cfg.cert_file and cfg.key_file:
         cert_pem = open(cfg.cert_file, "rb").read()
         key_pem = open(cfg.key_file, "rb").read()

@@ -331,20 +331,26 @@ class MeshBackend(PersistenceHost):
         Returns [n, B]-shaped host response dicts per round."""
         from gubernator_tpu.runtime.backend import tally_from_rounds
 
-        now = np.int64(self.clock.millisecond_now())
-        round_resps = []
         with self._lock:
-            for db in rounds:
-                t = tier_of(db.active, self._tiers)
-                batch = jax.device_put(
-                    pack_grid_batch(db)[:, :, :t], self._psharding
-                )
-                self.table, resp = self._step_packed(self.table, batch, now)
-                round_resps.append(resp)
+            round_resps = self._dispatch_rounds_locked(rounds)
         host = packed_grid_rounds_to_host(round_resps)
         if add_tally:
             self._add_tally(tally_from_rounds(rounds, host))
         return host
+
+    def _dispatch_rounds_locked(self, rounds) -> list:
+        """Dispatch grid rounds; caller holds `_lock` (see
+        DeviceBackend._dispatch_rounds_locked)."""
+        now = np.int64(self.clock.millisecond_now())
+        round_resps = []
+        for db in rounds:
+            t = tier_of(db.active, self._tiers)
+            batch = jax.device_put(
+                pack_grid_batch(db)[:, :, :t], self._psharding
+            )
+            self.table, resp = self._step_packed(self.table, batch, now)
+            round_resps.append(resp)
+        return round_resps
 
     def warmup(self) -> None:
         """Compile the sharded executables with a synthetic batch that

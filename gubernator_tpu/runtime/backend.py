@@ -403,16 +403,9 @@ class DeviceBackend(PersistenceHost):
         response dicts per round; with add_tally, tallies update
         vectorized (the fast lane passes False and counts per REQUEST —
         cascade occurrences share device lanes)."""
-        now = np.int64(self.clock.millisecond_now())
-        round_resps = []
         t_start = time.monotonic()
         with self._lock:
-            for db in rounds:
-                t = tier_of(db.active, self._tiers)
-                self.table, packed_resp = self._step_packed_q(
-                    self.table, pack_batch_q(db)[:, :t], now
-                )
-                round_resps.append(packed_resp)
+            round_resps = self._dispatch_rounds_locked(rounds)
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start
@@ -421,6 +414,21 @@ class DeviceBackend(PersistenceHost):
         if add_tally:
             self._add_tally(tally_from_rounds(rounds, host))
         return host
+
+    def _dispatch_rounds_locked(self, rounds) -> list:
+        """Dispatch pre-packed rounds; caller holds `_lock`.  Returns the
+        device response handles WITHOUT syncing them — the fast lane's
+        cascade section syncs inside the lock (its critical window spans
+        the sync) while the plain path syncs after release."""
+        now = np.int64(self.clock.millisecond_now())
+        round_resps = []
+        for db in rounds:
+            t = tier_of(db.active, self._tiers)
+            self.table, packed_resp = self._step_packed_q(
+                self.table, pack_batch_q(db)[:, :t], now
+            )
+            round_resps.append(packed_resp)
+        return round_resps
 
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
         """found-mask for a host hash vector, probing in fixed batch_size

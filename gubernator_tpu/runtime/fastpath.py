@@ -36,6 +36,7 @@ the semantic reference:
 from __future__ import annotations
 
 import asyncio
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -58,12 +59,27 @@ _SKIP_MASK = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
 
 
 class FastPath:
-    """Per-service compiled lane with a coalescing columnar batcher."""
+    """Per-service compiled lane with a coalescing columnar batcher.
 
-    def __init__(self, service) -> None:
+    Merges PIPELINE up to `max_inflight` deep: the remote-link cost of a
+    step is dominated by the synchronous response round-trip (a tunneled
+    device adds ~65ms per sync while pipelined dispatch costs ~5ms), so
+    overlapping one merge's response sync with the next merge's dispatch
+    multiplies E2E throughput by the pipeline depth.  Dispatch order is
+    serialized by the backend lock; cascade merges hold that lock across
+    their whole read -> replay -> write-back window, which serializes them
+    against every other mutation path (this lane, the object path, the
+    GLOBAL managers) exactly like any other single-writer section."""
+
+    def __init__(self, service, max_inflight: int = 3) -> None:
         self.s = service
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_inflight, thread_name_prefix="tpu-fastlane"
+        )
+        self._inflight = asyncio.Semaphore(max_inflight)
+        self._dispatches: set = set()
         # Servings since start (observability; also asserted in tests to
         # prove the fast lane actually ran).
         self.served = 0
@@ -208,38 +224,50 @@ class FastPath:
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            entries = [await self._queue.get()]
+            first = await self._queue.get()
+            # Take the pipeline slot BEFORE draining: while the pipeline
+            # is saturated, arrivals keep accumulating in the queue and
+            # ship as ONE bigger merge — coalescing depth is what
+            # amortizes the per-merge device round-trip.
+            try:
+                await self._inflight.acquire()
+            except asyncio.CancelledError:
+                # Shutdown while holding a dequeued entry: fail it
+                # instead of orphaning its awaiting handler.
+                if not first.fut.done():
+                    first.fut.set_exception(RuntimeError("fastpath closed"))
+                raise
+            entries = [first]
             while True:
                 try:
                     entries.append(self._queue.get_nowait())
                 except asyncio.QueueEmpty:
                     break
-            try:
-                outs = await loop.run_in_executor(
-                    self.s._dev_executor, lambda: self._process(entries)
-                )
-            except asyncio.CancelledError:
-                # Shutdown mid-step: fail the dequeued entries instead of
-                # orphaning their awaiting handlers.
-                err = RuntimeError("fastpath closed")
-                for en in entries:
-                    if not en.fut.done():
-                        en.fut.set_exception(err)
-                raise
-            except Exception as e:  # noqa: BLE001
-                for en in entries:
-                    if not en.fut.done():
-                        en.fut.set_exception(e)
-                continue
+            task = asyncio.ensure_future(self._dispatch(loop, entries))
+            self._dispatches.add(task)
+            task.add_done_callback(self._dispatches.discard)
+
+    async def _dispatch(self, loop, entries) -> None:
+        try:
+            outs = await loop.run_in_executor(
+                self._pool, lambda: self._process(entries)
+            )
+        except Exception as e:  # noqa: BLE001 — includes CancelledError
+            for en in entries:
+                if not en.fut.done():
+                    en.fut.set_exception(e)
+        else:
             for en, out in zip(entries, outs):
                 if not en.fut.done():
                     en.fut.set_result(out)
+        finally:
+            self._inflight.release()
 
     def _process(
         self, entries: Sequence["_Entry"]
     ) -> List[Tuple[np.ndarray, ...]]:
-        """Pack -> step -> gather for a coalesced entry list (runs on the
-        device-executor thread; everything here is numpy/C++/device).
+        """Pack -> step -> gather for a coalesced entry list (runs on a
+        fast-lane pool thread; everything here is numpy/C++/device).
 
         Duplicate-heavy batches (Zipfian hot keys) would otherwise explode
         into one device round PER OCCURRENCE of the hottest key; eligible
@@ -247,10 +275,7 @@ class FastPath:
         one read lane, an exact host-side replay of the per-occurrence
         algorithm branches, and one effective write-back lane — two rounds
         total regardless of skew."""
-        from gubernator_tpu.runtime.backend import Tally, tally_from_rounds
-
-        backend = self.s.backend
-        cfg = backend.cfg
+        cfg = self.s.backend.cfg
         n_shards = cfg.num_shards
         B = cfg.batch_size
 
@@ -278,6 +303,14 @@ class FastPath:
 
         plan = _plan_cascade(h, hits, reset_remaining, is_greg,
                              lim, dur, algo, burst)
+
+        from gubernator_tpu.runtime.backend import (
+            Tally,
+            packed_rounds_to_host,
+            tally_from_rounds,
+        )
+
+        backend = self.s.backend
         if plan is None:
             h_mach, hits_mach = h, hits
         else:
@@ -289,9 +322,13 @@ class FastPath:
 
         if n_shards > 1:
             from gubernator_tpu.parallel.mesh import shard_of_hash
+            from gubernator_tpu.parallel.sharded import (
+                packed_grid_rounds_to_host as to_host,
+            )
 
             sh_all = shard_of_hash(h, n_shards).astype(np.int32)
         else:
+            to_host = packed_rounds_to_host
             sh_all = np.zeros(n, dtype=np.int32)
         rnd, lane, n_rounds = native.assign_rounds(
             h_mach, sh_all if n_shards > 1 else None, n_shards, B
@@ -305,56 +342,72 @@ class FastPath:
         rounds, order, bounds = _build_rounds(
             values, rnd, lane, sh_all, n_rounds, n_shards, B
         )
-        host = backend.step_rounds(rounds, add_tally=False)
 
         status = np.zeros(n, dtype=np.int64)
         out_lim = np.zeros(n, dtype=np.int64)
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
         stored = np.zeros(n, dtype=np.int64)
-        for r_idx in range(n_rounds):
-            sel = order[bounds[r_idx]:bounds[r_idx + 1]]
-            hr = host[r_idx]
-            if n_shards > 1:
-                idx = (sh_all[sel], lane[sel])
-            else:
-                idx = (lane[sel],)
-            status[sel] = hr["status"][idx]
-            out_lim[sel] = hr["limit"][idx]
-            remaining[sel] = hr["remaining"][idx]
-            reset[sel] = hr["reset_time"][idx]
-            stored[sel] = hr["stored"][idx]
 
-        if plan is not None:
-            wb = _run_cascade(
-                plan, h, hits, lim, dur, algo, burst,
-                status, out_lim, remaining, reset, stored,
-            )
-            if wb is not None:
-                wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
-                wb_sh = (
-                    shard_of_hash(wb_h, n_shards).astype(np.int32)
-                    if n_shards > 1 else None
+        def gather(host) -> None:
+            for r_idx in range(n_rounds):
+                sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+                hr = host[r_idx]
+                if n_shards > 1:
+                    idx = (sh_all[sel], lane[sel])
+                else:
+                    idx = (lane[sel],)
+                status[sel] = hr["status"][idx]
+                out_lim[sel] = hr["limit"][idx]
+                remaining[sel] = hr["remaining"][idx]
+                reset[sel] = hr["reset_time"][idx]
+                stored[sel] = hr["stored"][idx]
+
+        if plan is None:
+            # Plain merge: dispatch under the backend lock, sync outside —
+            # merges pipeline against each other's response round-trips.
+            host = backend.step_rounds(rounds, add_tally=False)
+            gather(host)
+        else:
+            # Cascade merge: the read -> host replay -> write-back window
+            # must not interleave with ANY other step on these keys — from
+            # this lane, the object path, or the GLOBAL managers — so the
+            # whole window runs under the backend lock (the same
+            # single-writer discipline as every other mutation path).  The
+            # write-back itself needs no response sync: the replay already
+            # produced every response, and dispatch order serializes it.
+            with backend._lock:
+                host = to_host(backend._dispatch_rounds_locked(rounds))
+                gather(host)
+                wb = _run_cascade(
+                    plan, h, hits, lim, dur, algo, burst,
+                    status, out_lim, remaining, reset, stored,
                 )
-                wrnd, wlane, wn = native.assign_rounds(
-                    wb_h, wb_sh, n_shards, B
-                )
-                m = len(wb_h)
-                wvals = dict(
-                    key_hash=wb_h, hits=wb_hits, limit=wb_lim,
-                    duration=wb_dur, algo=wb_algo, burst=wb_burst,
-                    reset_remaining=np.zeros(m, dtype=bool),
-                    is_greg=np.zeros(m, dtype=bool),
-                    greg_expire=np.zeros(m, dtype=np.int64),
-                    greg_duration=np.zeros(m, dtype=np.int64),
-                )
-                wb_rounds, _, _ = _build_rounds(
-                    wvals, wrnd, wlane,
-                    wb_sh if wb_sh is not None
-                    else np.zeros(m, dtype=np.int32),
-                    wn, n_shards, B,
-                )
-                backend.step_rounds(wb_rounds, add_tally=False)
+                if wb is not None:
+                    wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
+                    wb_sh = (
+                        shard_of_hash(wb_h, n_shards).astype(np.int32)
+                        if n_shards > 1 else None
+                    )
+                    wrnd, wlane, wn = native.assign_rounds(
+                        wb_h, wb_sh, n_shards, B
+                    )
+                    m = len(wb_h)
+                    wvals = dict(
+                        key_hash=wb_h, hits=wb_hits, limit=wb_lim,
+                        duration=wb_dur, algo=wb_algo, burst=wb_burst,
+                        reset_remaining=np.zeros(m, dtype=bool),
+                        is_greg=np.zeros(m, dtype=bool),
+                        greg_expire=np.zeros(m, dtype=np.int64),
+                        greg_duration=np.zeros(m, dtype=np.int64),
+                    )
+                    wb_rounds, _, _ = _build_rounds(
+                        wvals, wrnd, wlane,
+                        wb_sh if wb_sh is not None
+                        else np.zeros(m, dtype=np.int32),
+                        wn, n_shards, B,
+                    )
+                    backend._dispatch_rounds_locked(wb_rounds)
 
         # Metric parity: checks/over-limit from the per-REQUEST outputs
         # (cascade occurrences never had their own device lane); cache
@@ -385,11 +438,17 @@ class FastPath:
             self._task.cancel()
             await asyncio.gather(self._task, return_exceptions=True)
             self._task = None
+        # Let in-flight dispatches finish (their entries get results).
+        if self._dispatches:
+            await asyncio.gather(
+                *list(self._dispatches), return_exceptions=True
+            )
         # Entries still queued (never dequeued by _run) must fail too.
         while not self._queue.empty():
             en = self._queue.get_nowait()
             if not en.fut.done():
                 en.fut.set_exception(RuntimeError("fastpath closed"))
+        self._pool.shutdown(wait=True)
 
 
 class _Entry:
