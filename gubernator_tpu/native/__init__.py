@@ -54,13 +54,27 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         lib = _try_load()
+        if lib is not None:
+            try:
+                lib = _bind(lib)
+            except AttributeError as e:
+                # Loaded fine but misses symbols: a STALE .so from an
+                # older build.  Rebuild and retry like a failed dlopen.
+                log.info("stale native library (%s); rebuilding", e)
+                lib = None
         if lib is None:
-            # Missing, stale-arch, or torn artifact: rebuild once and retry.
+            # Missing, stale, torn, or wrong-arch: rebuild once and retry.
             if _build():
                 lib = _try_load()
-        if lib is None:
-            return None
-        lib = _bind(lib)
+                if lib is not None:
+                    try:
+                        lib = _bind(lib)
+                    except AttributeError as e:
+                        log.warning(
+                            "rebuilt native library still missing "
+                            "symbols: %s", e,
+                        )
+                        lib = None
         _lib = lib
         return _lib
 
@@ -107,8 +121,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
     ]
     lib.gub_parse_reqs.restype = ctypes.c_int64
+    lib.gub_parse_resps.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.gub_parse_resps.restype = ctypes.c_int64
     lib.gub_serialize_resps.argtypes = [
         ctypes.c_int64,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
@@ -117,6 +145,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ctypes.c_char_p,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_char_p,   # owner_blob (may be None)
+        ctypes.c_void_p,   # owner_off (int64* or None)
         np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
         ctypes.c_int64,
     ]
@@ -184,7 +214,7 @@ class ParsedReqs:
 
     __slots__ = (
         "n", "hash", "err", "hits", "limit", "duration", "algo",
-        "behavior", "burst",
+        "behavior", "burst", "msg_off", "msg_len",
     )
 
     def __init__(self, n: int) -> None:
@@ -197,6 +227,19 @@ class ParsedReqs:
         self.algo = np.empty(n, dtype=np.int32)
         self.behavior = np.empty(n, dtype=np.int64)
         self.burst = np.empty(n, dtype=np.int64)
+        # Each request's raw wire frame within the payload (tag + length
+        # varint + body) — splice these to forward without re-encoding.
+        self.msg_off = np.empty(n, dtype=np.int64)
+        self.msg_len = np.empty(n, dtype=np.int64)
+
+    def subset(self, idx: np.ndarray) -> "ParsedReqs":
+        """Row-subset view (fancy-indexed copies) for split routing."""
+        out = ParsedReqs.__new__(ParsedReqs)
+        out.n = len(idx)
+        for f in ("hash", "err", "hits", "limit", "duration", "algo",
+                  "behavior", "burst", "msg_off", "msg_len"):
+            setattr(out, f, getattr(self, f)[idx])
+        return out
 
 
 def parse_reqs(payload: bytes) -> Optional[ParsedReqs]:
@@ -213,6 +256,45 @@ def parse_reqs(payload: bytes) -> Optional[ParsedReqs]:
     got = lib.gub_parse_reqs(
         payload, len(payload), n, cols.hash, cols.err, cols.hits,
         cols.limit, cols.duration, cols.algo, cols.behavior, cols.burst,
+        cols.msg_off, cols.msg_len,
+    )
+    if got != n:
+        return None
+    return cols
+
+
+class ParsedResps:
+    """Columnar view of a GetPeerRateLimitsResp payload (gub_parse_resps).
+    err_off/err_len index into the payload bytes (lazy error slicing)."""
+
+    __slots__ = (
+        "n", "status", "limit", "remaining", "reset_time",
+        "err_off", "err_len",
+    )
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self.status = np.empty(n, dtype=np.int64)
+        self.limit = np.empty(n, dtype=np.int64)
+        self.remaining = np.empty(n, dtype=np.int64)
+        self.reset_time = np.empty(n, dtype=np.int64)
+        self.err_off = np.empty(n, dtype=np.int64)
+        self.err_len = np.empty(n, dtype=np.int64)
+
+
+def parse_resps(payload: bytes) -> Optional[ParsedResps]:
+    """Parse raw GetRateLimitsResp / GetPeerRateLimitsResp bytes into
+    columns; None when unavailable/malformed."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.gub_count_reqs(payload, len(payload))  # same field-1 framing
+    if n < 0:
+        return None
+    cols = ParsedResps(int(n))
+    got = lib.gub_parse_resps(
+        payload, len(payload), n, cols.status, cols.limit, cols.remaining,
+        cols.reset_time, cols.err_off, cols.err_len,
     )
     if got != n:
         return None
@@ -226,17 +308,28 @@ def serialize_resps(
     reset_time: np.ndarray,
     err_blob: bytes,
     err_off: np.ndarray,
+    owner_blob: Optional[bytes] = None,
+    owner_off: Optional[np.ndarray] = None,
 ) -> bytes:
     """Emit GetRateLimitsResp / GetPeerRateLimitsResp wire bytes from packed
-    response columns.  Native only (callers gate on available())."""
+    response columns; owner_blob/owner_off add per-request "owner" metadata
+    (forwarded responses).  Native only (callers gate on available())."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     n = len(status)
     # Worst case per item: 4 varint fields (<=11 B each) + submsg framing
-    # (<=6 B) + error bytes (+3 B framing).
-    cap = n * 50 + len(err_blob) + n * 3 + 16
+    # (<=6 B) + error bytes (+3 B framing) + owner metadata (+14 B framing).
+    cap = (
+        n * 64 + len(err_blob)
+        + (len(owner_blob) if owner_blob else 0) + 16
+    )
     out = np.empty(cap, dtype=np.uint8)
+    if owner_off is not None:
+        owner_off = np.ascontiguousarray(owner_off, dtype=np.int64)
+        owner_off_ptr = owner_off.ctypes.data_as(ctypes.c_void_p)
+    else:
+        owner_off_ptr = None
     written = lib.gub_serialize_resps(
         n,
         np.ascontiguousarray(status, dtype=np.int64),
@@ -245,6 +338,8 @@ def serialize_resps(
         np.ascontiguousarray(reset_time, dtype=np.int64),
         err_blob,
         np.ascontiguousarray(err_off, dtype=np.int64),
+        owner_blob,
+        owner_off_ptr,
         out,
         cap,
     )

@@ -106,6 +106,7 @@ class PeerClient:
         self._creds = channel_credentials
         self._channel: Optional[grpc.aio.Channel] = None
         self._stub: Optional[grpc_api.PeersV1Stub] = None
+        self._raw_get_peer_rate_limits = None
         self._connect_lock = asyncio.Lock()
         # Batch queue: (request, future) pairs.
         self._queue: asyncio.Queue[Tuple[RateLimitReq, asyncio.Future]] = (
@@ -149,6 +150,12 @@ class PeerClient:
                     self.peer_info.grpc_address
                 )
             self._stub = grpc_api.PeersV1Stub(self._channel)
+            # Raw-bytes method for the compiled routing lane (payloads are
+            # pre-encoded byte splices; a pb round-trip here would undo
+            # the zero-copy forward).
+            self._raw_get_peer_rate_limits = self._channel.unary_unary(
+                f"/{grpc_api.PEERS_SERVICE}/GetPeerRateLimits"
+            )
             self._batcher_task = asyncio.ensure_future(self._run_batcher())
             return self._stub
 
@@ -208,6 +215,28 @@ class PeerClient:
             # path (the GLOBAL flush) decide retry-safety via
             # provably_unsent(), and a blanket UNAVAILABLE conversion would
             # make a mid-RPC socket reset look retry-safe (double count).
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def get_peer_rate_limits_raw(self, payload: bytes) -> bytes:
+        """One pre-encoded GetPeerRateLimitsReq as a raw-bytes RPC — the
+        compiled router's zero-copy forward.  Same shutdown/error
+        accounting as the batch path; retry-safety stays with the caller
+        (the router falls back to the object path's ownership-retry loop
+        per request on failure)."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        self._track_inflight(+1)
+        try:
+            await self._connect()
+            return await self._raw_get_peer_rate_limits(
+                payload, timeout=self.behavior.batch_timeout_s
+            )
+        except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
             raise
         finally:

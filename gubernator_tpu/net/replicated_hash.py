@@ -74,6 +74,7 @@ class ReplicatedConsistentHash(Generic[P]):
         self._peers: Dict[str, P] = {}
         self._ring_hashes: List[int] = []
         self._ring_peers: List[P] = []
+        self._ring_cache = None
 
     def new(self) -> "ReplicatedConsistentHash[P]":
         """Fresh empty picker with the same parameters (PeerPicker.New)."""
@@ -106,6 +107,29 @@ class ReplicatedConsistentHash(Generic[P]):
         )
         self._ring_hashes = [h for h, _ in merged]
         self._ring_peers = [p for _, p in merged]
+        self._ring_cache = None
+
+    def ring_arrays(self):
+        """(ring_hashes uint64[N], ring_peer_idx int32[N], peers list) for
+        vectorized owner lookup — one np.searchsorted replaces per-key
+        bisects on the compiled routing lane.  Cached until the next add().
+        Only meaningful when hash_fn hashes the same bytes the caller
+        hashed (the fast router checks hash_fn is xx_64, which equals the
+        device fingerprint XXH64 of the hash-key string)."""
+        import numpy as np
+
+        if self._ring_cache is None:
+            peers = list(self._peers.values())
+            index = {id(p): i for i, p in enumerate(peers)}
+            self._ring_cache = (
+                np.array(self._ring_hashes, dtype=np.uint64),
+                np.array(
+                    [index[id(p)] for p in self._ring_peers],
+                    dtype=np.int32,
+                ),
+                peers,
+            )
+        return self._ring_cache
 
     def get(self, key: str) -> P:
         """Owning peer for `key`: first ring point at/after hash(key),

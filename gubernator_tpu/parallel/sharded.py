@@ -341,7 +341,10 @@ class MeshBackend(PersistenceHost):
     def _dispatch_rounds_locked(self, rounds) -> list:
         """Dispatch grid rounds; caller holds `_lock` (see
         DeviceBackend._dispatch_rounds_locked)."""
+        import time as time_mod
+
         now = np.int64(self.clock.millisecond_now())
+        t_start = time_mod.monotonic()
         round_resps = []
         for db in rounds:
             t = tier_of(db.active, self._tiers)
@@ -350,6 +353,10 @@ class MeshBackend(PersistenceHost):
             )
             self.table, resp = self._step_packed(self.table, batch, now)
             round_resps.append(resp)
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time_mod.monotonic() - t_start
+            )
         return round_resps
 
     def warmup(self) -> None:

@@ -403,13 +403,8 @@ class DeviceBackend(PersistenceHost):
         response dicts per round; with add_tally, tallies update
         vectorized (the fast lane passes False and counts per REQUEST —
         cascade occurrences share device lanes)."""
-        t_start = time.monotonic()
         with self._lock:
             round_resps = self._dispatch_rounds_locked(rounds)
-        if self.metrics is not None:
-            self.metrics.device_step_duration.observe(
-                time.monotonic() - t_start
-            )
         host = packed_rounds_to_host(round_resps)
         if add_tally:
             self._add_tally(tally_from_rounds(rounds, host))
@@ -421,6 +416,7 @@ class DeviceBackend(PersistenceHost):
         cascade section syncs inside the lock (its critical window spans
         the sync) while the plain path syncs after release."""
         now = np.int64(self.clock.millisecond_now())
+        t_start = time.monotonic()
         round_resps = []
         for db in rounds:
             t = tier_of(db.active, self._tiers)
@@ -428,6 +424,10 @@ class DeviceBackend(PersistenceHost):
                 self.table, pack_batch_q(db)[:, :t], now
             )
             round_resps.append(packed_resp)
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
         return round_resps
 
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:

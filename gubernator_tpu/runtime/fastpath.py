@@ -119,7 +119,8 @@ class FastPath:
         if not self._eligible():
             self.fallbacks += 1
             return None
-        if not peer_rpc and not self._single_node():
+        routed = not peer_rpc and not self._single_node()
+        if routed and not self._can_route():
             self.fallbacks += 1
             return None
         cols = native.parse_reqs(payload)
@@ -156,14 +157,18 @@ class FastPath:
                 self.s._inflight_checks
             )
         try:
-            return await self._serve(cols, n, peer_rpc)
+            if routed:
+                return await self._serve_routed(payload, cols, n)
+            return await self._serve(cols, n)
         finally:
             if not peer_rpc:
                 self.s._inflight_checks -= 1
 
-    async def _serve(self, cols, n: int, peer_rpc: bool) -> bytes:
-        """Gregorian prep -> coalescing batcher -> response bytes."""
-        # Host-side Gregorian expiry (rare; only flagged lanes loop).
+    def _prep_greg(self, cols) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                        Dict[int, bytes]]:
+        """Host-side Gregorian expiry (rare; only flagged lanes loop).
+        Marks failed lanes in cols.err and zeroes their hashes."""
+        n = cols.n
         greg_expire = np.zeros(n, dtype=np.int64)
         greg_duration = np.zeros(n, dtype=np.int64)
         is_greg = (
@@ -185,39 +190,218 @@ class FastPath:
                     err_extra[i] = str(e).encode()
                     cols.err[i] = _ERR_GREG
                     cols.hash[i] = 0
+        return is_greg, greg_expire, greg_duration, err_extra
 
+    def _error_strings(self, cols, err_extra) -> List[bytes]:
+        """Per-request error bytes (b'' on clean lanes)."""
+        out = [b""] * cols.n
+        if cols.err.any():
+            for i in np.flatnonzero(cols.err):
+                i = int(i)
+                code = int(cols.err[i])
+                out[i] = (
+                    err_extra.get(i, b"")
+                    if code == _ERR_GREG
+                    else (_ERR_EMPTY_KEY if code == 1 else _ERR_EMPTY_NAME)
+                )
+        return out
+
+    async def _serve_cols(self, cols, is_greg, ge, gd) -> Tuple[np.ndarray,
+                                                                ...]:
+        """Submit columns to the coalescing batcher; returns the four
+        response arrays (status, limit, remaining, reset_time)."""
         entry = _Entry(
             cols=cols,
             is_greg=is_greg,
-            greg_expire=greg_expire,
-            greg_duration=greg_duration,
+            greg_expire=ge,
+            greg_duration=gd,
             fut=asyncio.get_running_loop().create_future(),
         )
         if self._task is None:
             self._task = asyncio.ensure_future(self._run())
         await self._queue.put(entry)
-        status, limit, remaining, reset = await entry.fut
+        return await entry.fut
 
-        # Error strings (canned validation + Gregorian); zero on hot lanes.
-        blobs: List[bytes] = []
+    async def _serve(self, cols, n: int) -> bytes:
+        """Single-node / peer-RPC path: everything is local."""
+        is_greg, ge, gd, err_extra = self._prep_greg(cols)
+        status, limit, remaining, reset = await self._serve_cols(
+            cols, is_greg, ge, gd
+        )
+        errs = self._error_strings(cols, err_extra)
         err_off = np.zeros(n + 1, dtype=np.int64)
-        if cols.err.any():
-            for i in np.flatnonzero(cols.err):
-                i = int(i)
-                code = int(cols.err[i])
-                e = (
-                    err_extra.get(i, b"")
-                    if code == _ERR_GREG
-                    else (_ERR_EMPTY_KEY if code == 1 else _ERR_EMPTY_NAME)
-                )
-                blobs.append(e)
-                err_off[i + 1] = len(e)
-            np.cumsum(err_off[1:], out=err_off[1:])
-        blob = b"".join(blobs)
-
+        np.cumsum([len(e) for e in errs], out=err_off[1:])
         self.served += n
         return native.serialize_resps(
-            status, limit, remaining, reset, blob, err_off
+            status, limit, remaining, reset, b"".join(errs), err_off
+        )
+
+    def _can_route(self) -> bool:
+        """Columnar routing needs the ring hash to equal the device
+        fingerprint hash (XXH64 of the hash-key string) so the C++ parse
+        output drives the owner lookup directly."""
+        from gubernator_tpu.net.replicated_hash import xx_64
+
+        return self.s.local_picker.hash_fn is xx_64
+
+    async def _serve_routed(self, payload: bytes, cols, n: int) -> bytes:
+        """Multi-node client path: vectorized consistent-hash routing with
+        zero-copy forwards.
+
+        One np.searchsorted over the vnode ring maps every request to its
+        owner; locally-owned (and errored) lanes ride the normal columnar
+        lane, while each remote owner receives ONE GetPeerRateLimits RPC
+        whose payload is spliced verbatim from this request's wire bytes —
+        no re-encoding in either direction (the reference's asyncRequests
+        + peer batcher, gubernator.go:327-416, with the per-request python
+        replaced by array ops).  Failed forwards fall back to the object
+        path's ownership-retry loop per request."""
+        picker = self.s.local_picker
+        ring, ring_idx, peers = picker.ring_arrays()
+        if len(peers) == 0:
+            self.fallbacks += 1
+            return None  # type: ignore[return-value]
+        h_u = cols.hash.view(np.uint64)
+        slot = np.searchsorted(ring, h_u, side="left")
+        slot[slot == len(ring)] = 0
+        owner = ring_idx[slot]  # peer index per request
+        is_owner = np.array(
+            [p.info().is_owner for p in peers], dtype=bool
+        )
+        local_mask = (cols.err != 0) | is_owner[owner]
+
+        status = np.zeros(n, dtype=np.int64)
+        out_lim = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        errs: List[bytes] = [b""] * n
+        owners: List[bytes] = [b""] * n
+
+        async def serve_local(idx: np.ndarray) -> None:
+            sub = cols.subset(idx)
+            is_greg, ge, gd, err_extra = self._prep_greg(sub)
+            st, lm, rem, rst = await self._serve_cols(sub, is_greg, ge, gd)
+            status[idx] = st
+            out_lim[idx] = lm
+            remaining[idx] = rem
+            reset[idx] = rst
+            sub_errs = self._error_strings(sub, err_extra)
+            for j, i in enumerate(idx):
+                if sub_errs[j]:
+                    errs[int(i)] = sub_errs[j]
+            self.s.metrics.getratelimit_counter.labels("local").inc(
+                len(idx)
+            )
+
+        async def forward(peer, idx: np.ndarray) -> None:
+            import grpc as grpc_mod
+
+            from gubernator_tpu.net.peer_client import PeerNotReadyError
+
+            addr = peer.info().grpc_address.encode()
+            sub_pay = b"".join(
+                payload[cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]]
+                for i in idx
+            )
+            self.s.metrics.getratelimit_counter.labels("forward").inc(
+                len(idx)
+            )
+            try:
+                raw = await peer.get_peer_rate_limits_raw(sub_pay)
+            except Exception as e:  # noqa: BLE001
+                # Retry ONLY the failures the object path retries
+                # (NotReady / UNAVAILABLE / CANCELLED, which _forward
+                # re-resolves with backoff — gubernator.go:382-395).
+                # Anything else may follow a delivered batch, and a
+                # re-send would double-count the hits.
+                retriable = isinstance(e, PeerNotReadyError) or (
+                    isinstance(e, grpc_mod.aio.AioRpcError)
+                    and e.code() in (
+                        grpc_mod.StatusCode.UNAVAILABLE,
+                        grpc_mod.StatusCode.CANCELLED,
+                    )
+                )
+                if retriable:
+                    await forward_fallback(peer, idx)
+                else:
+                    msg = (
+                        "Error while fetching rate limit from peer "
+                        f"'{peer.info().grpc_address}': {e}"
+                    ).encode()
+                    for i in idx:
+                        errs[int(i)] = msg
+                return
+            rc = native.parse_resps(raw)
+            if rc is None or rc.n != len(idx):
+                # A response ARRIVED, so the peer applied the batch —
+                # never re-send; report the protocol error instead.
+                msg = (
+                    "peer '%s' returned %s responses for %d requests"
+                    % (
+                        peer.info().grpc_address,
+                        "unparseable" if rc is None else rc.n,
+                        len(idx),
+                    )
+                ).encode()
+                for i in idx:
+                    errs[int(i)] = msg
+                return
+            status[idx] = rc.status
+            out_lim[idx] = rc.limit
+            remaining[idx] = rc.remaining
+            reset[idx] = rc.reset_time
+            for j, i in enumerate(idx):
+                i = int(i)
+                if rc.err_len[j]:
+                    o = int(rc.err_off[j])
+                    errs[i] = raw[o:o + int(rc.err_len[j])]
+                owners[i] = addr
+
+        async def forward_fallback(peer, idx: np.ndarray) -> None:
+            """Re-route failed forwards through the object path's retry
+            loop (ownership changes, NotReady backoff — service._forward).
+            """
+            from gubernator_tpu.net.grpc_api import req_from_pb
+            from gubernator_tpu.proto import gubernator_pb2 as pb
+
+            async def one(i: int) -> None:
+                frame = payload[
+                    cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]
+                ]
+                m = pb.GetRateLimitsReq.FromString(frame).requests[0]
+                req = req_from_pb(m)
+                resp = await self.s._forward(peer, req, req.hash_key())
+                status[i] = int(resp.status)
+                out_lim[i] = resp.limit
+                remaining[i] = resp.remaining
+                reset[i] = resp.reset_time
+                if resp.error:
+                    errs[i] = resp.error.encode()
+                o = resp.metadata.get("owner", "")
+                if o:
+                    owners[i] = o.encode()
+
+            await asyncio.gather(*(one(int(i)) for i in idx))
+
+        tasks = []
+        local_idx = np.flatnonzero(local_mask)
+        if len(local_idx):
+            tasks.append(serve_local(local_idx))
+        remote_idx = np.flatnonzero(~local_mask)
+        if len(remote_idx):
+            for pi in np.unique(owner[remote_idx]):
+                idx = remote_idx[owner[remote_idx] == pi]
+                tasks.append(forward(peers[int(pi)], idx))
+        await asyncio.gather(*tasks)
+
+        err_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in errs], out=err_off[1:])
+        owner_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(o) for o in owners], out=owner_off[1:])
+        self.served += n
+        return native.serialize_resps(
+            status, out_lim, remaining, reset,
+            b"".join(errs), err_off, b"".join(owners), owner_off,
         )
 
     # -- coalescing batcher ---------------------------------------------
@@ -252,10 +436,16 @@ class FastPath:
             outs = await loop.run_in_executor(
                 self._pool, lambda: self._process(entries)
             )
-        except Exception as e:  # noqa: BLE001 — includes CancelledError
+        except BaseException as e:  # CancelledError is a BaseException
+            err = (
+                RuntimeError("fastpath closed")
+                if isinstance(e, asyncio.CancelledError) else e
+            )
             for en in entries:
                 if not en.fut.done():
-                    en.fut.set_exception(e)
+                    en.fut.set_exception(err)
+            if isinstance(e, asyncio.CancelledError):
+                raise
         else:
             for en, out in zip(entries, outs):
                 if not en.fut.done():

@@ -273,17 +273,22 @@ int64_t gub_count_reqs(const uint8_t* buf, int64_t len) {
 // Parse the payload into per-request columns.  err[i]: 0 ok, 1 empty
 // unique_key, 2 empty name (matching the service's validation order and
 // messages).  hash[i] = XXH64(name + "_" + unique_key) with 0 remapped to 1;
-// 0 on errored requests.  Returns the parsed count, or -1 on malformed
-// input (callers fall back to the python-protobuf path for the real error).
+// 0 on errored requests.  msg_off/msg_len give each RateLimitReq's frame
+// (tag byte + length varint + body) within the payload, so a router can
+// splice request bytes verbatim into a peer-forward payload without
+// re-encoding.  Returns the parsed count, or -1 on malformed input
+// (callers fall back to the python-protobuf path for the real error).
 int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
                        int64_t* hash, int32_t* err, int64_t* hits,
                        int64_t* limit, int64_t* duration, int32_t* algo,
-                       int64_t* behavior, int64_t* burst) {
+                       int64_t* behavior, int64_t* burst,
+                       int64_t* msg_off, int64_t* msg_len) {
   const uint8_t* p = buf;
   const uint8_t* end = buf + len;
   int64_t n = 0;
   std::vector<uint8_t> scratch;
   while (p < end) {
+    const uint8_t* frame_start = p;
     uint64_t tag;
     if (!get_varint(p, end, &tag)) return -1;
     if ((tag >> 3) != 1 || (tag & 7) != 2) {
@@ -296,6 +301,8 @@ int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
     const uint8_t* q = p;
     const uint8_t* qend = p + sz;
     p = qend;
+    msg_off[n] = (int64_t)(frame_start - buf);
+    msg_len[n] = (int64_t)(qend - frame_start);
 
     const uint8_t* name = nullptr;
     uint64_t name_len = 0;
@@ -362,6 +369,62 @@ int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
   return n;
 }
 
+// Parse a GetRateLimitsResp / GetPeerRateLimitsResp payload into response
+// columns (status=1 limit=2 remaining=3 reset_time=4 error=5); the router
+// uses this to merge peer-forwarded responses back into its output
+// columns.  err_off/err_len index INTO the payload (zero len = no error).
+// Returns the item count, or -1 on malformed input.
+int64_t gub_parse_resps(const uint8_t* buf, int64_t len, int64_t cap,
+                        int64_t* status, int64_t* limit, int64_t* remaining,
+                        int64_t* reset_time, int64_t* err_off,
+                        int64_t* err_len) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  int64_t n = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return -1;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {
+      if (!skip_field(p, end, (uint32_t)(tag & 7))) return -1;
+      continue;
+    }
+    uint64_t sz;
+    if (!get_varint(p, end, &sz) || (uint64_t)(end - p) < sz) return -1;
+    if (n >= cap) return -1;
+    const uint8_t* q = p;
+    const uint8_t* qend = p + sz;
+    p = qend;
+    status[n] = limit[n] = remaining[n] = reset_time[n] = 0;
+    err_off[n] = err_len[n] = 0;
+    while (q < qend) {
+      uint64_t t;
+      if (!get_varint(q, qend, &t)) return -1;
+      uint32_t field = (uint32_t)(t >> 3);
+      uint32_t wire = (uint32_t)(t & 7);
+      if (wire == 0 && field >= 1 && field <= 4) {
+        uint64_t v;
+        if (!get_varint(q, qend, &v)) return -1;
+        switch (field) {
+          case 1: status[n] = (int64_t)v; break;
+          case 2: limit[n] = (int64_t)v; break;
+          case 3: remaining[n] = (int64_t)v; break;
+          case 4: reset_time[n] = (int64_t)v; break;
+        }
+      } else if (wire == 2 && field == 5) {
+        uint64_t l;
+        if (!get_varint(q, qend, &l) || (uint64_t)(qend - q) < l) return -1;
+        err_off[n] = (int64_t)(q - buf);
+        err_len[n] = (int64_t)l;
+        q += l;
+      } else {
+        if (!skip_field(q, qend, wire)) return -1;
+      }
+    }
+    n++;
+  }
+  return n;
+}
+
 static inline int varint_size(uint64_t v) {
   int s = 1;
   while (v >= 0x80) {
@@ -381,24 +444,36 @@ static inline void put_varint(uint8_t*& w, uint64_t v) {
 
 // Emit GetRateLimitsResp (or GetPeerRateLimitsResp) bytes from packed
 // response columns.  err_blob/err_off carry per-request error strings
-// (err_off[i]..err_off[i+1]); zero-length means no error.  Zero-valued
-// fields are omitted like proto3 requires.  Returns bytes written, or -1
-// if `cap` is too small.
+// (err_off[i]..err_off[i+1]); zero-length means no error.  owner_blob/
+// owner_off (may be null) carry a per-request "owner" metadata value —
+// the forwarded-response annotation (gubernator.go asyncRequests).
+// Zero-valued fields are omitted like proto3 requires.  Returns bytes
+// written, or -1 if `cap` is too small.
 int64_t gub_serialize_resps(int64_t n, const int64_t* status,
                             const int64_t* limit, const int64_t* remaining,
                             const int64_t* reset_time,
                             const uint8_t* err_blob, const int64_t* err_off,
+                            const uint8_t* owner_blob,
+                            const int64_t* owner_off,
                             uint8_t* out, int64_t cap) {
   uint8_t* w = out;
   uint8_t* wend = out + cap;
   for (int64_t i = 0; i < n; i++) {
     uint64_t elen = (uint64_t)(err_off[i + 1] - err_off[i]);
+    uint64_t olen =
+        owner_off ? (uint64_t)(owner_off[i + 1] - owner_off[i]) : 0;
     size_t body = 0;
     if (status[i]) body += 1 + varint_size((uint64_t)status[i]);
     if (limit[i]) body += 1 + varint_size((uint64_t)limit[i]);
     if (remaining[i]) body += 1 + varint_size((uint64_t)remaining[i]);
     if (reset_time[i]) body += 1 + varint_size((uint64_t)reset_time[i]);
     if (elen) body += 1 + varint_size(elen) + elen;
+    size_t entry = 0;
+    if (olen) {
+      // map<string,string> entry: key=1 ("owner"), value=2.
+      entry = (1 + 1 + 5) + (1 + varint_size(olen) + olen);
+      body += 1 + varint_size(entry) + entry;
+    }
     size_t total = 1 + varint_size(body) + body;
     if ((size_t)(wend - w) < total) return -1;
     *w++ = 0x0A;  // field 1, wire 2
@@ -424,6 +499,18 @@ int64_t gub_serialize_resps(int64_t n, const int64_t* status,
       put_varint(w, elen);
       std::memcpy(w, err_blob + err_off[i], elen);
       w += elen;
+    }
+    if (olen) {
+      *w++ = 0x32;  // field 6 (metadata), wire 2
+      put_varint(w, entry);
+      *w++ = 0x0A;  // map key, wire 2
+      *w++ = 5;
+      std::memcpy(w, "owner", 5);
+      w += 5;
+      *w++ = 0x12;  // map value, wire 2
+      put_varint(w, olen);
+      std::memcpy(w, owner_blob + owner_off[i], olen);
+      w += olen;
     }
   }
   return (int64_t)(w - out);

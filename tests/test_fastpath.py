@@ -299,3 +299,85 @@ def test_fastpath_sticky_token_status(frozen_clock):
         await s_ref.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_multinode_columnar_routing():
+    """Multi-node client path on the compiled lane: vectorized ring
+    lookup, zero-copy forwards to owners, owner metadata on forwarded
+    responses, and consistent counting across the cluster."""
+    c = Cluster.start(3)
+    try:
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        keys = [f"rt{i}" for i in range(60)]
+        reqs = [
+            RateLimitReq(name="route", unique_key=k, hits=1, limit=10,
+                         duration=60_000)
+            for k in keys
+        ]
+        r1 = cl.get_rate_limits(reqs)
+        assert all(x.error == "" for x in r1)
+        assert all(x.remaining == 9 for x in r1)
+        r2 = cl.get_rate_limits(reqs)
+        assert all(x.remaining == 8 for x in r2)
+        # The router served them (no object-path fallback).
+        assert fp.served == 120
+        assert fp.fallbacks == 0
+        # Forwarded responses carry the owner address; local ones don't.
+        me = c.daemons[0].advertise_address()
+        others = {d.advertise_address() for d in c.daemons[1:]}
+        forwarded = [x for x in r2 if x.metadata]
+        local = [x for x in r2 if not x.metadata]
+        assert forwarded and local  # 60 keys spread over 3 nodes
+        assert {x.metadata["owner"] for x in forwarded} <= others
+        assert me not in {x.metadata.get("owner") for x in forwarded}
+        # The owner side rode the peer fast lane on the other daemons
+        # (both calls forwarded the same key set).
+        assert sum(d.fastpath.served for d in c.daemons[1:]) == 2 * len(
+            forwarded
+        )
+        # Validation errors answer locally even on the routed path.
+        bad = cl.get_rate_limits([
+            RateLimitReq(name="", unique_key="x", hits=1, limit=1,
+                         duration=1000)
+        ])
+        assert bad[0].error == "field 'namespace' cannot be empty"
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_multinode_routing_peer_failure_fallback():
+    """A dead owner mid-forward must degrade exactly like the object
+    path: the ownership-retry loop runs and reports the reference's
+    error string instead of hanging or crashing the batch."""
+    c = Cluster.start(2)
+    try:
+        cl = V1Client(c.addresses()[0])
+        # Find keys owned by daemon 1, then kill it without telling
+        # daemon 0 (no discovery update).
+        keys = [f"dead{i}" for i in range(40)]
+        svc = c.daemons[0].service
+        other = c.daemons[1].advertise_address()
+        victim_keys = [
+            k for k in keys
+            if svc.get_peer(f"route_{k}").info().grpc_address == other
+        ]
+        assert victim_keys
+        c.run(c.daemons[1].close(), timeout=60)
+
+        reqs = [
+            RateLimitReq(name="route", unique_key=k, hits=1, limit=10,
+                         duration=60_000)
+            for k in keys
+        ]
+        rs = cl.get_rate_limits(reqs)
+        by_key = dict(zip(keys, rs))
+        for k in victim_keys:
+            assert by_key[k].error != "", k
+        # Locally-owned keys still served cleanly.
+        for k in set(keys) - set(victim_keys):
+            assert by_key[k].error == "" and by_key[k].remaining == 9, k
+        cl.close()
+    finally:
+        c.stop()
