@@ -26,9 +26,13 @@ Eligibility — anything else falls back to the object path, which remains
 the semantic reference:
   - native library loadable;
   - no Store / Loader / sketch tier attached (their hooks are per-key);
-  - no GLOBAL / MULTI_REGION behaviors in the batch (they route through
-    the managers);
-  - for the client-facing RPC: single-node (no peers to forward to).
+  - no MULTI_REGION behaviors in the batch (they route through the
+    manager).  GLOBAL is served HERE — use_cached lanes for non-owned
+    reads, queued hits/updates for the managers — except when the mesh
+    GlobalEngine owns it (ICI-collective path);
+  - for the client-facing RPC: either single-node, or the columnar
+    router (vectorized ring lookup + zero-copy forwards) when the ring
+    hash matches the device fingerprint hash.
     Peer-to-peer batches (GetPeerRateLimits) are always local by
     construction, so the fast lane also serves the owner side of
     forwarded traffic in a cluster.
@@ -55,7 +59,11 @@ _ERR_EMPTY_KEY = b"field 'unique_key' cannot be empty"
 _ERR_EMPTY_NAME = b"field 'namespace' cannot be empty"
 _ERR_GREG = 3  # parse err code for host-side Gregorian failures
 
-_SKIP_MASK = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
+# MULTI_REGION still routes through the managers on the object path;
+# GLOBAL is served on the compiled lane (use_cached lanes + queued
+# hits/updates) except when the mesh GlobalEngine owns it.
+_SKIP_MASK = int(Behavior.MULTI_REGION)
+_GLOBAL = int(Behavior.GLOBAL)
 
 
 class FastPath:
@@ -148,6 +156,11 @@ class FastPath:
         if n and (cols.behavior & _SKIP_MASK).any():
             self.fallbacks += 1
             return None
+        is_global = (cols.behavior & _GLOBAL) != 0
+        if is_global.any() and self.s.global_engine is not None:
+            # Mesh GLOBAL rides the ICI-collective engine (object path).
+            self.fallbacks += 1
+            return None
         if n == 0:
             return b""
         if not peer_rpc:
@@ -158,8 +171,10 @@ class FastPath:
             )
         try:
             if routed:
-                return await self._serve_routed(payload, cols, n)
-            return await self._serve(cols, n)
+                return await self._serve_routed(
+                    payload, cols, n, is_global
+                )
+            return await self._serve(payload, cols, n, is_global)
         finally:
             if not peer_rpc:
                 self.s._inflight_checks -= 1
@@ -206,8 +221,9 @@ class FastPath:
                 )
         return out
 
-    async def _serve_cols(self, cols, is_greg, ge, gd) -> Tuple[np.ndarray,
-                                                                ...]:
+    async def _serve_cols(
+        self, cols, is_greg, ge, gd, use_cached=None
+    ) -> Tuple[np.ndarray, ...]:
         """Submit columns to the coalescing batcher; returns the four
         response arrays (status, limit, remaining, reset_time)."""
         entry = _Entry(
@@ -215,6 +231,10 @@ class FastPath:
             is_greg=is_greg,
             greg_expire=ge,
             greg_duration=gd,
+            use_cached=(
+                use_cached if use_cached is not None
+                else np.zeros(cols.n, dtype=bool)
+            ),
             fut=asyncio.get_running_loop().create_future(),
         )
         if self._task is None:
@@ -222,12 +242,52 @@ class FastPath:
         await self._queue.put(entry)
         return await entry.fut
 
-    async def _serve(self, cols, n: int) -> bytes:
-        """Single-node / peer-RPC path: everything is local."""
+    def _queue_global(self, payload, cols, idx, as_update: bool) -> None:
+        """Queue GLOBAL hits (non-owner) or broadcast updates (owner) for
+        the request indices `idx` — the deferred QueueHit/QueueUpdate of
+        gubernator.go:429-432/617.  One decode per UNIQUE key with summed
+        hits (the manager aggregates by key anyway, global.go:87-95)."""
+        from dataclasses import replace as dc_replace
+
+        from gubernator_tpu.net.grpc_api import req_from_pb
+        from gubernator_tpu.proto import gubernator_pb2 as pb
+
+        if not len(idx):
+            return
+        order = idx[np.argsort(cols.hash[idx], kind="stable")]
+        hs = cols.hash[order]
+        bounds = np.flatnonzero(
+            np.concatenate([[True], hs[1:] != hs[:-1]])
+        )
+        mgr = self.s.global_mgr
+        for b_i, lo in enumerate(bounds):
+            hi = bounds[b_i + 1] if b_i + 1 < len(bounds) else len(order)
+            group = order[lo:hi]
+            fi = int(group[0])
+            frame = payload[
+                cols.msg_off[fi]:cols.msg_off[fi] + cols.msg_len[fi]
+            ]
+            m = pb.GetRateLimitsReq.FromString(frame).requests[0]
+            req = req_from_pb(m)
+            if as_update:
+                mgr.queue_update(req)
+            else:
+                total = int(cols.hits[group].sum())
+                mgr.queue_hit(dc_replace(req, hits=total))
+
+    async def _serve(self, payload, cols, n: int, is_global) -> bytes:
+        """Single-node / peer-RPC path: everything is local (and owned, so
+        GLOBAL lanes serve authoritatively and queue broadcast updates)."""
         is_greg, ge, gd, err_extra = self._prep_greg(cols)
         status, limit, remaining, reset = await self._serve_cols(
             cols, is_greg, ge, gd
         )
+        if is_global.any():
+            self._queue_global(
+                payload, cols,
+                np.flatnonzero(is_global & (cols.err == 0)),
+                as_update=True,
+            )
         errs = self._error_strings(cols, err_extra)
         err_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(e) for e in errs], out=err_off[1:])
@@ -244,7 +304,9 @@ class FastPath:
 
         return self.s.local_picker.hash_fn is xx_64
 
-    async def _serve_routed(self, payload: bytes, cols, n: int) -> bytes:
+    async def _serve_routed(
+        self, payload: bytes, cols, n: int, is_global
+    ) -> bytes:
         """Multi-node client path: vectorized consistent-hash routing with
         zero-copy forwards.
 
@@ -268,7 +330,12 @@ class FastPath:
         is_owner = np.array(
             [p.info().is_owner for p in peers], dtype=bool
         )
-        local_mask = (cols.err != 0) | is_owner[owner]
+        owned = is_owner[owner]
+        # GLOBAL never forwards: non-owned GLOBAL serves from the local
+        # replica via use_cached lanes (stale-but-fast reads,
+        # gubernator.go:420-460) with the hit queued to the owner.
+        glob_cached = is_global & ~owned & (cols.err == 0)
+        local_mask = (cols.err != 0) | owned | is_global
 
         status = np.zeros(n, dtype=np.int64)
         out_lim = np.zeros(n, dtype=np.int64)
@@ -280,7 +347,13 @@ class FastPath:
         async def serve_local(idx: np.ndarray) -> None:
             sub = cols.subset(idx)
             is_greg, ge, gd, err_extra = self._prep_greg(sub)
-            st, lm, rem, rst = await self._serve_cols(sub, is_greg, ge, gd)
+            # _prep_greg marked Gregorian failures on the subset COPY —
+            # propagate so the GLOBAL queue/metadata block (filtered on
+            # cols.err == 0) never replicates or annotates a failed lane.
+            cols.err[idx] = sub.err
+            st, lm, rem, rst = await self._serve_cols(
+                sub, is_greg, ge, gd, use_cached=glob_cached[idx]
+            )
             status[idx] = st
             out_lim[idx] = lm
             remaining[idx] = rem
@@ -289,9 +362,15 @@ class FastPath:
             for j, i in enumerate(idx):
                 if sub_errs[j]:
                     errs[int(i)] = sub_errs[j]
-            self.s.metrics.getratelimit_counter.labels("local").inc(
-                len(idx)
-            )
+            # Metric parity: the object path labels owner-side GLOBAL
+            # "local" (service.py routing); only non-owned GLOBAL reads
+            # count as "global".
+            n_glob = int(glob_cached[idx].sum())
+            m = self.s.metrics.getratelimit_counter
+            if n_glob:
+                m.labels("global").inc(n_glob)
+            if len(idx) - n_glob:
+                m.labels("local").inc(len(idx) - n_glob)
 
         async def forward(peer, idx: np.ndarray) -> None:
             import grpc as grpc_mod
@@ -394,6 +473,22 @@ class FastPath:
                 tasks.append(forward(peers[int(pi)], idx))
         await asyncio.gather(*tasks)
 
+        if is_global.any():
+            # Deferred GLOBAL replication (gubernator.go:429-432, 617):
+            # non-owned keys queue their hits toward the owner; owned keys
+            # queue broadcast updates.  Owner metadata on the served reads.
+            gc_idx = np.flatnonzero(glob_cached & (cols.err == 0))
+            for i in gc_idx:
+                owners[int(i)] = peers[
+                    int(owner[int(i)])
+                ].info().grpc_address.encode()
+            self._queue_global(payload, cols, gc_idx, as_update=False)
+            self._queue_global(
+                payload, cols,
+                np.flatnonzero(is_global & owned & (cols.err == 0)),
+                as_update=True,
+            )
+
         err_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(e) for e in errs], out=err_off[1:])
         owner_off = np.zeros(n + 1, dtype=np.int64)
@@ -475,6 +570,7 @@ class FastPath:
             algo, burst, behavior = c.algo, c.burst, c.behavior
             is_greg = entries[0].is_greg
             ge, gd = entries[0].greg_expire, entries[0].greg_duration
+            use_cached = entries[0].use_cached
         else:
             h = np.concatenate([e.cols.hash for e in entries])
             hits = np.concatenate([e.cols.hits for e in entries])
@@ -486,13 +582,14 @@ class FastPath:
             is_greg = np.concatenate([e.is_greg for e in entries])
             ge = np.concatenate([e.greg_expire for e in entries])
             gd = np.concatenate([e.greg_duration for e in entries])
+            use_cached = np.concatenate([e.use_cached for e in entries])
         n = len(h)
 
         burst = np.where(burst == 0, lim, burst)
         reset_remaining = (behavior & int(Behavior.RESET_REMAINING)) != 0
 
         plan = _plan_cascade(h, hits, reset_remaining, is_greg,
-                             lim, dur, algo, burst)
+                             lim, dur, algo, burst, use_cached)
 
         from gubernator_tpu.runtime.backend import (
             Tally,
@@ -509,6 +606,12 @@ class FastPath:
             h_mach[plan.occ] = 0          # divert cascade occurrences
             h_mach[plan.firsts] = h[plan.firsts]  # keep one READ lane
             hits_mach[plan.firsts] = 0
+            # Cached-read groups: one serving lane (its own hits), the
+            # rest share its response.
+            if len(plan.read_groups):
+                rf = plan.first_idx[plan.read_groups]
+                h_mach[plan.read_occ] = 0
+                h_mach[rf] = h[rf]
 
         if n_shards > 1:
             from gubernator_tpu.parallel.mesh import shard_of_hash
@@ -528,6 +631,7 @@ class FastPath:
             key_hash=h_mach, hits=hits_mach, limit=lim, duration=dur,
             algo=algo, burst=burst, reset_remaining=reset_remaining,
             is_greg=is_greg, greg_expire=ge, greg_duration=gd,
+            use_cached=use_cached,
         )
         rounds, order, bounds = _build_rounds(
             values, rnd, lane, sh_all, n_rounds, n_shards, B
@@ -553,9 +657,11 @@ class FastPath:
                 reset[sel] = hr["reset_time"][idx]
                 stored[sel] = hr["stored"][idx]
 
-        if plan is None:
-            # Plain merge: dispatch under the backend lock, sync outside —
-            # merges pipeline against each other's response round-trips.
+        if plan is None or not len(plan.groups):
+            # Plain merge (cached-read dedup included — its single lane is
+            # atomic within the machinery): dispatch under the backend
+            # lock, sync outside — merges pipeline against each other's
+            # response round-trips.
             host = backend.step_rounds(rounds, add_tally=False)
             gather(host)
         else:
@@ -572,7 +678,7 @@ class FastPath:
                 wb = _run_cascade(
                     plan, h, hits, lim, dur, algo, burst,
                     status, out_lim, remaining, reset, stored,
-                )
+                )  # noqa: E501 — read-group copy happens after the branch
                 if wb is not None:
                     wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
                     wb_sh = (
@@ -598,6 +704,17 @@ class FastPath:
                         wn, n_shards, B,
                     )
                     backend._dispatch_rounds_locked(wb_rounds)
+
+        if plan is not None and len(plan.read_groups):
+            # Cached-read dedup: duplicates share the serving lane's
+            # response (the GLOBAL engine's documented aggregation
+            # semantics, parallel/global_sync.py GlobalEngine.check).
+            ri = np.flatnonzero(plan.read_occ)
+            src = plan.first_idx[plan.inv[ri]]
+            status[ri] = status[src]
+            out_lim[ri] = out_lim[src]
+            remaining[ri] = remaining[src]
+            reset[ri] = reset[src]
 
         # Metric parity: checks/over-limit from the per-REQUEST outputs
         # (cascade occurrences never had their own device lane); cache
@@ -642,13 +759,18 @@ class FastPath:
 
 
 class _Entry:
-    __slots__ = ("cols", "is_greg", "greg_expire", "greg_duration", "fut")
+    __slots__ = (
+        "cols", "is_greg", "greg_expire", "greg_duration", "use_cached",
+        "fut",
+    )
 
-    def __init__(self, cols, is_greg, greg_expire, greg_duration, fut):
+    def __init__(self, cols, is_greg, greg_expire, greg_duration,
+                 use_cached, fut):
         self.cols = cols
         self.is_greg = is_greg
         self.greg_expire = greg_expire
         self.greg_duration = greg_duration
+        self.use_cached = use_cached
         self.fut = fut
 
 
@@ -674,25 +796,41 @@ def _build_rounds(values, rnd, lane, sh_all, n_rounds, n_shards, B):
 
 
 class _CascadePlan:
-    __slots__ = ("occ", "firsts", "groups", "inv")
+    __slots__ = ("occ", "firsts", "groups", "inv", "read_occ",
+                 "read_groups", "first_idx")
 
-    def __init__(self, occ, firsts, groups, inv):
+    def __init__(self, occ, firsts, groups, inv, read_occ, read_groups,
+                 first_idx):
         self.occ = occ          # bool[n]: occurrence is in a cascade group
         self.firsts = firsts    # int[-]: first-occurrence index per group
         self.groups = groups    # int[-]: group ids (into inv's codomain)
         self.inv = inv          # int[n]: np.unique inverse (key group id)
+        # Cached-read dedup (GLOBAL non-owner lanes): duplicate use_cached
+        # groups keep ONE lane and share its response.
+        self.read_occ = read_occ      # bool[n]
+        self.read_groups = read_groups  # int[-]: group ids
+        self.first_idx = first_idx    # int[nb]: first occurrence per group
 
 
-def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst):
-    """Pick duplicate-key groups the host cascade can serve exactly.
+def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
+                  use_cached):
+    """Pick duplicate-key groups the host can serve without one device
+    round per occurrence.
 
-    Eligible: >1 occurrence of a key where every occurrence has positive
-    hits, no RESET_REMAINING, no Gregorian duration, and identical
-    limit/duration/algorithm/burst.  The per-occurrence branch order of
-    the kernel (over-at-zero / exact / over-more / under) is then a pure
-    function of the running remaining, replayable on host from the read
-    lane's post-step `stored` value.  Anything else keeps the exact
-    round-per-occurrence machinery."""
+    Exact-cascade groups: >1 occurrence of a key where every occurrence
+    has positive hits, no RESET_REMAINING, no Gregorian duration, no
+    use_cached flag, and identical limit/duration/algorithm/burst.  The
+    per-occurrence branch order of the kernel (over-at-zero / exact /
+    over-more / under) is then a pure function of the running remaining,
+    replayable on host from the read lane's post-step `stored` value.
+
+    Cached-read groups: >1 occurrence where EVERY occurrence is a
+    use_cached lane (GLOBAL non-owner serving) with identical params —
+    one lane serves, duplicates share its response (the hit aggregation
+    already rode the GLOBAL queue per entry; matches the collective
+    engine's documented dedup, parallel/global_sync.py).
+
+    Anything else keeps the exact round-per-occurrence machinery."""
     uniq, first_idx, inv, counts = np.unique(
         h, return_index=True, return_inverse=True, return_counts=True
     )
@@ -700,24 +838,34 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst):
     if not dup.any():
         return None
     nb = len(uniq)
-    bad_occ = (hits <= 0) | reset_remaining | is_greg
-    grp_bad = np.bincount(
-        inv, weights=bad_occ.astype(np.float64), minlength=nb
-    ) > 0
     same = np.ones(nb, dtype=bool)
     for arr in (lim, dur, burst, algo.astype(np.int64)):
         diff = arr != arr[first_idx][inv]
         same &= np.bincount(
             inv, weights=diff.astype(np.float64), minlength=nb
         ) == 0
+
+    bad_occ = (hits <= 0) | reset_remaining | is_greg | use_cached
+    grp_bad = np.bincount(
+        inv, weights=bad_occ.astype(np.float64), minlength=nb
+    ) > 0
     casc = dup & ~grp_bad & same
-    if not casc.any():
+
+    grp_uncached = np.bincount(
+        inv, weights=(~use_cached).astype(np.float64), minlength=nb
+    ) > 0
+    reads = dup & ~grp_uncached & same
+
+    if not casc.any() and not reads.any():
         return None
     return _CascadePlan(
         occ=casc[inv],
         firsts=first_idx[casc],
         groups=np.flatnonzero(casc),
         inv=inv,
+        read_occ=reads[inv],
+        read_groups=np.flatnonzero(reads),
+        first_idx=first_idx,
     )
 
 

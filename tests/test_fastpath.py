@@ -117,17 +117,68 @@ def test_fast_lane_leaky_and_gregorian(node, client):
     assert fp.served == before + 3
 
 
-def test_global_falls_back_to_object_path(node, client):
-    """GLOBAL behavior routes through the managers — the fast lane must
-    decline, and the response must still be correct."""
+def test_global_serves_on_fast_lane(node, client):
+    """GLOBAL on a single node = owner side: the compiled lane serves
+    authoritatively and queues the broadcast update for the manager
+    (the deferred QueueUpdate of gubernator.go:617)."""
     fp = _fp(node)
-    before_fb = fp.fallbacks
+    before = fp.served
+    mgr = node.daemons[0].service.global_mgr
     r = client.get_rate_limits([
         RateLimitReq(name="fp_glob", unique_key="g", hits=1, limit=10,
                      duration=60_000, behavior=Behavior.GLOBAL)
     ])[0]
     assert r.error == "" and r.remaining == 9
-    assert fp.fallbacks > before_fb
+    assert fp.served == before + 1
+    assert mgr is not None
+    r2 = client.get_rate_limits([
+        RateLimitReq(name="fp_glob", unique_key="g", hits=2, limit=10,
+                     duration=60_000, behavior=Behavior.GLOBAL)
+    ])[0]
+    assert r2.remaining == 7
+
+
+def test_global_replication_on_fast_lane():
+    """Multi-node GLOBAL on the compiled lane: a non-owned key serves
+    locally (owner metadata, no forward), the queued hits reach the
+    owner, and the owner's broadcast comes back — the full
+    hits-up/status-down loop of global.go:78-250 with zero per-request
+    python on the serving path."""
+    import time
+
+    c = Cluster.start(3)
+    try:
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        svc = c.daemons[0].service
+        # Find a key NOT owned by daemon 0.
+        key = next(
+            k for k in (f"grep{i}" for i in range(50))
+            if not svc.get_peer(f"g_{k}").info().is_owner
+        )
+        owner_addr = svc.get_peer(f"g_{key}").info().grpc_address
+        owner_d = next(
+            d for d in c.daemons if d.advertise_address() == owner_addr
+        )
+        req = RateLimitReq(name="g", unique_key=key, hits=3, limit=100,
+                           duration=60_000, behavior=Behavior.GLOBAL)
+        r = cl.get_rate_limits([req])[0]
+        assert r.error == ""
+        assert r.remaining == 97  # processed locally as-if-owner (miss)
+        assert r.metadata == {"owner": owner_addr}
+        assert fp.served >= 1 and fp.fallbacks == 0
+
+        # The aggregated hit reaches the owner's authoritative bucket.
+        deadline = time.monotonic() + 10.0
+        while True:
+            item = owner_d.service.backend.get_cache_item(f"g_{key}")
+            if item is not None and item.remaining == 97:
+                break
+            assert time.monotonic() < deadline, item
+            time.sleep(0.05)
+        cl.close()
+    finally:
+        c.stop()
 
 
 def test_oversized_batch_rejected(node, client):
@@ -210,8 +261,18 @@ def test_fastpath_differential_duplicate_heavy(frozen_clock):
 
     async def scenario():
         dev = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
-        s_fast = Service(Config(device=dev), clock=frozen_clock)
-        s_ref = Service(Config(device=dev), clock=frozen_clock)
+        # A never-closing GLOBAL sync window keeps the async broadcast
+        # loops from re-reading state mid-test (the hits=0 re-read
+        # mutates leak timestamps and would race the clock advances).
+        from gubernator_tpu.core.config import BehaviorConfig
+
+        quiet = BehaviorConfig(global_sync_wait_s=3600.0)
+        s_fast = Service(
+            Config(device=dev, behaviors=quiet), clock=frozen_clock
+        )
+        s_ref = Service(
+            Config(device=dev, behaviors=quiet), clock=frozen_clock
+        )
         await s_fast.start()
         await s_ref.start()
         fp = FastPath(s_fast)
@@ -223,6 +284,8 @@ def test_fastpath_differential_duplicate_heavy(frozen_clock):
                 behavior = 0
                 if rng.random() < 0.05:
                     behavior |= 8  # RESET_REMAINING
+                if rng.random() < 0.10:
+                    behavior |= 2  # GLOBAL (single node = owner side)
                 reqs.append(pb.RateLimitReq(
                     name="diff",
                     unique_key=f"d{rng.randint(0, 7)}",  # hot duplicates
