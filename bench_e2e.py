@@ -228,11 +228,11 @@ def bench(seconds: float, concurrency: int) -> None:
     except Exception as e:  # noqa: BLE001 — isolate config failures
         print(json.dumps({"config": "global_4peer", "error": str(e)}))
 
-    # ---- config 5: CMS sketch tier daemon (fast lane declines; the
-    # sketch path is its own vectorized pipeline).  The XLA one-hot
-    # sketch path — the Pallas kernel's XLA compile over a remote-device
-    # tunnel exceeds the cluster boot timeout; its device-side number is
-    # measured by cli/microbench.py instead. -----------------------------
+    # ---- config 5: CMS sketch tier daemon (sketch-named lanes ride the
+    # compiled fast lane via the parser's name_hash column).  The Pallas
+    # kernel's XLA compile over a remote-device tunnel exceeds the
+    # cluster boot timeout; its device-side number is measured by
+    # cli/microbench.py instead (use_pallas=False here). ----------------
     from gubernator_tpu.core.config import DaemonConfig
 
     try:

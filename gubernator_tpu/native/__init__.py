@@ -109,7 +109,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.gub_assign_rounds.restype = ctypes.c_int64
     lib.gub_count_reqs.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.gub_count_reqs.restype = ctypes.c_int64
-    lib.gub_parse_reqs.argtypes = [
+    lib.gub_parse_reqs2.argtypes = [
         ctypes.c_char_p,
         ctypes.c_int64,
         ctypes.c_int64,
@@ -123,9 +123,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
     ]
-    lib.gub_parse_reqs.restype = ctypes.c_int64
-    lib.gub_parse_resps.argtypes = [
+    lib.gub_parse_reqs2.restype = ctypes.c_int64
+    lib.gub_parse_resps2.argtypes = [
         ctypes.c_char_p,
         ctypes.c_int64,
         ctypes.c_int64,
@@ -135,9 +136,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
     ]
-    lib.gub_parse_resps.restype = ctypes.c_int64
-    lib.gub_serialize_resps.argtypes = [
+    lib.gub_parse_resps2.restype = ctypes.c_int64
+    lib.gub_serialize_resps2.argtypes = [
         ctypes.c_int64,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
@@ -145,12 +148,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ctypes.c_char_p,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-        ctypes.c_char_p,   # owner_blob (may be None)
-        ctypes.c_void_p,   # owner_off (int64* or None)
+        ctypes.c_char_p,   # meta_blob (may be None)
+        ctypes.c_void_p,   # meta_off (int64* or None)
         np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
         ctypes.c_int64,
     ]
-    lib.gub_serialize_resps.restype = ctypes.c_int64
+    lib.gub_serialize_resps2.restype = ctypes.c_int64
     return lib
 
 
@@ -210,11 +213,11 @@ def assign_rounds(
 
 
 class ParsedReqs:
-    """Columnar view of a GetRateLimitsReq payload (gub_parse_reqs)."""
+    """Columnar view of a GetRateLimitsReq payload (gub_parse_reqs2)."""
 
     __slots__ = (
         "n", "hash", "err", "hits", "limit", "duration", "algo",
-        "behavior", "burst", "msg_off", "msg_len",
+        "behavior", "burst", "msg_off", "msg_len", "name_hash",
     )
 
     def __init__(self, n: int) -> None:
@@ -231,13 +234,16 @@ class ParsedReqs:
         # varint + body) — splice these to forward without re-encoding.
         self.msg_off = np.empty(n, dtype=np.int64)
         self.msg_len = np.empty(n, dtype=np.int64)
+        # XXH64 of the name field alone (0 when empty) — the route key
+        # for name-scoped tiers (sketch).
+        self.name_hash = np.empty(n, dtype=np.int64)
 
     def subset(self, idx: np.ndarray) -> "ParsedReqs":
         """Row-subset view (fancy-indexed copies) for split routing."""
         out = ParsedReqs.__new__(ParsedReqs)
         out.n = len(idx)
         for f in ("hash", "err", "hits", "limit", "duration", "algo",
-                  "behavior", "burst", "msg_off", "msg_len"):
+                  "behavior", "burst", "msg_off", "msg_len", "name_hash"):
             setattr(out, f, getattr(self, f)[idx])
         return out
 
@@ -253,10 +259,10 @@ def parse_reqs(payload: bytes) -> Optional[ParsedReqs]:
     if n < 0:
         return None
     cols = ParsedReqs(int(n))
-    got = lib.gub_parse_reqs(
+    got = lib.gub_parse_reqs2(
         payload, len(payload), n, cols.hash, cols.err, cols.hits,
         cols.limit, cols.duration, cols.algo, cols.behavior, cols.burst,
-        cols.msg_off, cols.msg_len,
+        cols.msg_off, cols.msg_len, cols.name_hash,
     )
     if got != n:
         return None
@@ -264,12 +270,14 @@ def parse_reqs(payload: bytes) -> Optional[ParsedReqs]:
 
 
 class ParsedResps:
-    """Columnar view of a GetPeerRateLimitsResp payload (gub_parse_resps).
-    err_off/err_len index into the payload bytes (lazy error slicing)."""
+    """Columnar view of a GetPeerRateLimitsResp payload (gub_parse_resps2).
+    err_off/err_len index into the payload bytes (lazy error slicing);
+    meta_off/meta_len cover each item's metadata map entries as raw wire
+    frames (meta_len -1 = fragmented, drop)."""
 
     __slots__ = (
         "n", "status", "limit", "remaining", "reset_time",
-        "err_off", "err_len",
+        "err_off", "err_len", "meta_off", "meta_len",
     )
 
     def __init__(self, n: int) -> None:
@@ -280,6 +288,8 @@ class ParsedResps:
         self.reset_time = np.empty(n, dtype=np.int64)
         self.err_off = np.empty(n, dtype=np.int64)
         self.err_len = np.empty(n, dtype=np.int64)
+        self.meta_off = np.empty(n, dtype=np.int64)
+        self.meta_len = np.empty(n, dtype=np.int64)
 
 
 def parse_resps(payload: bytes) -> Optional[ParsedResps]:
@@ -292,13 +302,33 @@ def parse_resps(payload: bytes) -> Optional[ParsedResps]:
     if n < 0:
         return None
     cols = ParsedResps(int(n))
-    got = lib.gub_parse_resps(
+    got = lib.gub_parse_resps2(
         payload, len(payload), n, cols.status, cols.limit, cols.remaining,
-        cols.reset_time, cols.err_off, cols.err_len,
+        cols.reset_time, cols.err_off, cols.err_len, cols.meta_off,
+        cols.meta_len,
     )
     if got != n:
         return None
     return cols
+
+
+def _encode_varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def meta_frame(key: bytes, value: bytes) -> bytes:
+    """A complete metadata map-entry wire frame (RateLimitResp field 6:
+    map<string,string>) for serialize_resps' meta_blob."""
+    body = (
+        b"\x0a" + _encode_varint(len(key)) + key
+        + b"\x12" + _encode_varint(len(value)) + value
+    )
+    return b"\x32" + _encode_varint(len(body)) + body
 
 
 def serialize_resps(
@@ -308,29 +338,30 @@ def serialize_resps(
     reset_time: np.ndarray,
     err_blob: bytes,
     err_off: np.ndarray,
-    owner_blob: Optional[bytes] = None,
-    owner_off: Optional[np.ndarray] = None,
+    meta_blob: Optional[bytes] = None,
+    meta_off: Optional[np.ndarray] = None,
 ) -> bytes:
     """Emit GetRateLimitsResp / GetPeerRateLimitsResp wire bytes from packed
-    response columns; owner_blob/owner_off add per-request "owner" metadata
-    (forwarded responses).  Native only (callers gate on available())."""
+    response columns; meta_blob/meta_off add per-request pre-encoded
+    metadata map-entry frames (see meta_frame; forwarded-owner and
+    sketch-tier annotations).  Native only (callers gate on available())."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
     n = len(status)
     # Worst case per item: 4 varint fields (<=11 B each) + submsg framing
-    # (<=6 B) + error bytes (+3 B framing) + owner metadata (+14 B framing).
+    # (<=6 B) + error bytes (+3 B framing); metadata frames are verbatim.
     cap = (
         n * 64 + len(err_blob)
-        + (len(owner_blob) if owner_blob else 0) + 16
+        + (len(meta_blob) if meta_blob else 0) + 16
     )
     out = np.empty(cap, dtype=np.uint8)
-    if owner_off is not None:
-        owner_off = np.ascontiguousarray(owner_off, dtype=np.int64)
-        owner_off_ptr = owner_off.ctypes.data_as(ctypes.c_void_p)
+    if meta_off is not None:
+        meta_off = np.ascontiguousarray(meta_off, dtype=np.int64)
+        meta_off_ptr = meta_off.ctypes.data_as(ctypes.c_void_p)
     else:
-        owner_off_ptr = None
-    written = lib.gub_serialize_resps(
+        meta_off_ptr = None
+    written = lib.gub_serialize_resps2(
         n,
         np.ascontiguousarray(status, dtype=np.int64),
         np.ascontiguousarray(limit, dtype=np.int64),
@@ -338,8 +369,8 @@ def serialize_resps(
         np.ascontiguousarray(reset_time, dtype=np.int64),
         err_blob,
         np.ascontiguousarray(err_off, dtype=np.int64),
-        owner_blob,
-        owner_off_ptr,
+        meta_blob,
+        meta_off_ptr,
         out,
         cap,
     )

@@ -10,13 +10,14 @@ peer_client.go:450-509, generated pb marshalers).
 This module is the equivalent compiled lane.  For eligible requests the
 daemon hands the raw gRPC payload straight here:
 
-    C++ parse  (native/gubtpu.cpp gub_parse_reqs: wire -> columns + XXH64)
+    C++ parse  (native/gubtpu.cpp gub_parse_reqs2: wire -> columns + XXH64)
     numpy      (burst defaults, behavior masks, shard routing)
     C++ pack   (gub_assign_rounds: duplicate-key round/lane assignment)
     numpy      (scatter columns into fixed-shape DeviceBatch rounds)
-    device     (backend.step_rounds: the same jitted kernels as check())
+    device     (backend.step_rounds: the same jitted kernels as check();
+                sketch-named lanes take one CMS step instead)
     numpy      (gather packed responses back to request order)
-    C++ emit   (gub_serialize_resps: columns -> response wire bytes)
+    C++ emit   (gub_serialize_resps2: columns -> response wire bytes)
 
 No per-request Python objects exist anywhere on this path.  Concurrent
 RPCs coalesce into shared device steps (the LocalBatcher discipline,
@@ -25,11 +26,15 @@ runtime/service.py) by concatenating their columns before packing.
 Eligibility — anything else falls back to the object path, which remains
 the semantic reference:
   - native library loadable;
-  - no Store / Loader / sketch tier attached (their hooks are per-key);
+  - no Store / Loader attached (their hooks are per-key);
   - no MULTI_REGION behaviors in the batch (they route through the
     manager).  GLOBAL is served HERE — use_cached lanes for non-owned
     reads, queued hits/updates for the managers — except when the mesh
     GlobalEngine owns it (ICI-collective path);
+  - sketch-tier names are served HERE too: the parser's name_hash
+    column routes them to SketchBackend.check_cols (one CMS step per
+    merge), with GLOBAL stripped exactly like the object path's
+    routing (service.py) so they count once at the key's owner;
   - for the client-facing RPC: either single-node, or the columnar
     router (vectorized ring lookup + zero-copy forwards) when the ring
     hash matches the device fingerprint hash.
@@ -65,6 +70,10 @@ _ERR_GREG = 3  # parse err code for host-side Gregorian failures
 _SKIP_MASK = int(Behavior.MULTI_REGION)
 _GLOBAL = int(Behavior.GLOBAL)
 
+# The sketch tier's response annotation (object path: metadata
+# {"tier": "sketch"}, runtime/sketch_backend.py).
+_TIER_SKETCH_FRAME = native.meta_frame(b"tier", b"sketch")
+
 
 class FastPath:
     """Per-service compiled lane with a coalescing columnar batcher.
@@ -92,6 +101,8 @@ class FastPath:
         # prove the fast lane actually ran).
         self.served = 0
         self.fallbacks = 0
+        self._owner_frames: Dict[bytes, bytes] = {}
+        self._sk_hashes: Optional[np.ndarray] = None
 
     # -- eligibility -----------------------------------------------------
     def _eligible(self) -> bool:
@@ -100,8 +111,24 @@ class FastPath:
             native.available()
             and b.store is None
             and b._keymap is None
-            and self.s.sketch_backend is None
         )
+
+    def _sketch_hashes(self) -> np.ndarray:
+        """XXH64 fingerprints of the sketch-tier names (route key for the
+        parser's name_hash column; the same 64-bit fingerprint stance the
+        slot table takes on full keys)."""
+        if self._sk_hashes is None:
+            self._sk_hashes = native.hash_keys(
+                sorted(self.s.sketch_backend.cfg.names)
+            )
+        return self._sk_hashes
+
+    def _owner_frame(self, addr: bytes) -> bytes:
+        f = self._owner_frames.get(addr)
+        if f is None:
+            f = native.meta_frame(b"owner", addr)
+            self._owner_frames[addr] = f
+        return f
 
     def _single_node(self) -> bool:
         """True when no request can need a peer forward: an empty picker,
@@ -156,6 +183,19 @@ class FastPath:
         if n and (cols.behavior & _SKIP_MASK).any():
             self.fallbacks += 1
             return None
+        sk: Optional[np.ndarray] = None
+        if self.s.sketch_backend is not None and n:
+            sk = np.isin(cols.name_hash, self._sketch_hashes()) & (
+                cols.err == 0
+            )
+            if sk.any():
+                # Sketch names don't compose with GLOBAL replication —
+                # strip the flag so they route plainly to the key's owner
+                # and count ONCE there (service.py's routing does the
+                # same on the object path).
+                cols.behavior[sk] &= ~_GLOBAL
+            else:
+                sk = None
         is_global = (cols.behavior & _GLOBAL) != 0
         if is_global.any() and self.s.global_engine is not None:
             # Mesh GLOBAL rides the ICI-collective engine (object path).
@@ -172,23 +212,29 @@ class FastPath:
         try:
             if routed:
                 return await self._serve_routed(
-                    payload, cols, n, is_global
+                    payload, cols, n, is_global, sk
                 )
-            return await self._serve(payload, cols, n, is_global)
+            return await self._serve(payload, cols, n, is_global, sk)
         finally:
             if not peer_rpc:
                 self.s._inflight_checks -= 1
 
-    def _prep_greg(self, cols) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
-                                        Dict[int, bytes]]:
+    def _prep_greg(self, cols, exclude=None) -> Tuple[
+        np.ndarray, np.ndarray, np.ndarray, Dict[int, bytes]
+    ]:
         """Host-side Gregorian expiry (rare; only flagged lanes loop).
-        Marks failed lanes in cols.err and zeroes their hashes."""
+        Marks failed lanes in cols.err and zeroes their hashes.
+        `exclude` masks lanes whose tier ignores duration entirely (the
+        sketch tier, which neither computes nor errors on Gregorian —
+        matching SketchBackend.check)."""
         n = cols.n
         greg_expire = np.zeros(n, dtype=np.int64)
         greg_duration = np.zeros(n, dtype=np.int64)
         is_greg = (
             cols.behavior & int(Behavior.DURATION_IS_GREGORIAN)
         ) != 0
+        if exclude is not None:
+            is_greg &= ~exclude
         err_extra: Dict[int, bytes] = {}
         if is_greg.any():
             now_dt = self.s.clock.now()
@@ -275,12 +321,76 @@ class FastPath:
                 total = int(cols.hits[group].sum())
                 mgr.queue_hit(dc_replace(req, hits=total))
 
-    async def _serve(self, payload, cols, n: int, is_global) -> bytes:
+    async def _serve_split(
+        self, cols, is_greg, ge, gd, use_cached, sk
+    ) -> Tuple[np.ndarray, ...]:
+        """Serve a column set, splitting sketch-named lanes to the CMS
+        step and the rest to the exact machinery; both run concurrently
+        and scatter into full-size response arrays."""
+        if sk is None or not sk.any():
+            return await self._serve_cols(
+                cols, is_greg, ge, gd, use_cached=use_cached
+            )
+        n = cols.n
+        sk_idx = np.flatnonzero(sk)
+        ex_idx = np.flatnonzero(~sk)
+        status = np.zeros(n, dtype=np.int64)
+        out_lim = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+
+        async def run_sketch() -> None:
+            kh = cols.hash[sk_idx]
+            hh = cols.hits[sk_idx]
+            ll = cols.limit[sk_idx]
+            loop = asyncio.get_running_loop()
+            st, rem, rst = await loop.run_in_executor(
+                self._pool,
+                lambda: self.s.sketch_backend.check_cols(kh, hh, ll),
+            )
+            status[sk_idx] = st
+            out_lim[sk_idx] = ll
+            remaining[sk_idx] = rem
+            reset[sk_idx] = rst
+
+        async def run_exact() -> None:
+            sub = cols.subset(ex_idx)
+            st, lm, rem, rst = await self._serve_cols(
+                sub, is_greg[ex_idx], ge[ex_idx], gd[ex_idx],
+                use_cached=(
+                    use_cached[ex_idx] if use_cached is not None else None
+                ),
+            )
+            status[ex_idx] = st
+            out_lim[ex_idx] = lm
+            remaining[ex_idx] = rem
+            reset[ex_idx] = rst
+
+        tasks = [run_sketch()]
+        if len(ex_idx):
+            tasks.append(run_exact())
+        await asyncio.gather(*tasks)
+        return status, out_lim, remaining, reset
+
+    @staticmethod
+    def _sketch_meta(n: int, sk) -> Tuple[Optional[bytes],
+                                          Optional[np.ndarray]]:
+        """(meta_blob, meta_off) tagging sketch lanes tier=sketch."""
+        if sk is None or not sk.any():
+            return None, None
+        metas = [
+            _TIER_SKETCH_FRAME if sk[i] else b"" for i in range(n)
+        ]
+        off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(m) for m in metas], out=off[1:])
+        return b"".join(metas), off
+
+    async def _serve(self, payload, cols, n: int, is_global, sk) -> bytes:
         """Single-node / peer-RPC path: everything is local (and owned, so
         GLOBAL lanes serve authoritatively and queue broadcast updates)."""
-        is_greg, ge, gd, err_extra = self._prep_greg(cols)
-        status, limit, remaining, reset = await self._serve_cols(
-            cols, is_greg, ge, gd
+        is_greg, ge, gd, err_extra = self._prep_greg(cols, exclude=sk)
+        status, limit, remaining, reset = await self._serve_split(
+            cols, is_greg, ge, gd, None, sk
         )
         if is_global.any():
             self._queue_global(
@@ -291,9 +401,11 @@ class FastPath:
         errs = self._error_strings(cols, err_extra)
         err_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(e) for e in errs], out=err_off[1:])
+        meta_blob, meta_off = self._sketch_meta(n, sk)
         self.served += n
         return native.serialize_resps(
-            status, limit, remaining, reset, b"".join(errs), err_off
+            status, limit, remaining, reset, b"".join(errs), err_off,
+            meta_blob, meta_off,
         )
 
     def _can_route(self) -> bool:
@@ -305,7 +417,7 @@ class FastPath:
         return self.s.local_picker.hash_fn is xx_64
 
     async def _serve_routed(
-        self, payload: bytes, cols, n: int, is_global
+        self, payload: bytes, cols, n: int, is_global, sk
     ) -> bytes:
         """Multi-node client path: vectorized consistent-hash routing with
         zero-copy forwards.
@@ -342,17 +454,20 @@ class FastPath:
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
         errs: List[bytes] = [b""] * n
-        owners: List[bytes] = [b""] * n
+        metas: List[bytes] = [b""] * n
 
         async def serve_local(idx: np.ndarray) -> None:
             sub = cols.subset(idx)
-            is_greg, ge, gd, err_extra = self._prep_greg(sub)
+            sub_sk = sk[idx] if sk is not None else None
+            is_greg, ge, gd, err_extra = self._prep_greg(
+                sub, exclude=sub_sk
+            )
             # _prep_greg marked Gregorian failures on the subset COPY —
             # propagate so the GLOBAL queue/metadata block (filtered on
             # cols.err == 0) never replicates or annotates a failed lane.
             cols.err[idx] = sub.err
-            st, lm, rem, rst = await self._serve_cols(
-                sub, is_greg, ge, gd, use_cached=glob_cached[idx]
+            st, lm, rem, rst = await self._serve_split(
+                sub, is_greg, ge, gd, glob_cached[idx], sub_sk
             )
             status[idx] = st
             out_lim[idx] = lm
@@ -362,6 +477,9 @@ class FastPath:
             for j, i in enumerate(idx):
                 if sub_errs[j]:
                     errs[int(i)] = sub_errs[j]
+            if sub_sk is not None:
+                for i in idx[sub_sk]:
+                    metas[int(i)] = _TIER_SKETCH_FRAME
             # Metric parity: the object path labels owner-side GLOBAL
             # "local" (service.py routing); only non-owned GLOBAL reads
             # count as "global".
@@ -429,12 +547,19 @@ class FastPath:
             out_lim[idx] = rc.limit
             remaining[idx] = rc.remaining
             reset[idx] = rc.reset_time
+            owner_frame = self._owner_frame(addr)
             for j, i in enumerate(idx):
                 i = int(i)
                 if rc.err_len[j]:
                     o = int(rc.err_off[j])
                     errs[i] = raw[o:o + int(rc.err_len[j])]
-                owners[i] = addr
+                # Splice the owner's metadata frames verbatim (tier tags
+                # etc.), then append this hop's owner annotation.
+                m = b""
+                if rc.meta_len[j] > 0:
+                    o = int(rc.meta_off[j])
+                    m = raw[o:o + int(rc.meta_len[j])]
+                metas[i] = m + owner_frame
 
         async def forward_fallback(peer, idx: np.ndarray) -> None:
             """Re-route failed forwards through the object path's retry
@@ -456,9 +581,11 @@ class FastPath:
                 reset[i] = resp.reset_time
                 if resp.error:
                     errs[i] = resp.error.encode()
-                o = resp.metadata.get("owner", "")
-                if o:
-                    owners[i] = o.encode()
+                if resp.metadata:
+                    metas[i] = b"".join(
+                        native.meta_frame(k.encode(), v.encode())
+                        for k, v in resp.metadata.items()
+                    )
 
             await asyncio.gather(*(one(int(i)) for i in idx))
 
@@ -479,9 +606,9 @@ class FastPath:
             # queue broadcast updates.  Owner metadata on the served reads.
             gc_idx = np.flatnonzero(glob_cached & (cols.err == 0))
             for i in gc_idx:
-                owners[int(i)] = peers[
-                    int(owner[int(i)])
-                ].info().grpc_address.encode()
+                metas[int(i)] = self._owner_frame(
+                    peers[int(owner[int(i)])].info().grpc_address.encode()
+                )
             self._queue_global(payload, cols, gc_idx, as_update=False)
             self._queue_global(
                 payload, cols,
@@ -491,12 +618,12 @@ class FastPath:
 
         err_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum([len(e) for e in errs], out=err_off[1:])
-        owner_off = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum([len(o) for o in owners], out=owner_off[1:])
+        meta_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(m) for m in metas], out=meta_off[1:])
         self.served += n
         return native.serialize_resps(
             status, out_lim, remaining, reset,
-            b"".join(errs), err_off, b"".join(owners), owner_off,
+            b"".join(errs), err_off, b"".join(metas), meta_off,
         )
 
     # -- coalescing batcher ---------------------------------------------

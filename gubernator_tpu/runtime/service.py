@@ -259,6 +259,32 @@ class Service:
     def peer_list(self) -> List[PeerClient]:
         return self.local_picker.peers()
 
+    def _strip_sketch_global(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> Sequence[RateLimitReq]:
+        """Sketch-tier names don't compose with GLOBAL replication (the
+        sketch is not broadcast); strip the flag so such requests route
+        plainly to the key's owner and are counted ONCE there instead of
+        locally-plus-forwarded (double counting).  Applied on both the
+        client routing path and the peer RPC (zero-copy forwards splice
+        the client's original bytes, so the owner re-strips)."""
+        if self.sketch_backend is None:
+            return reqs
+        from dataclasses import replace as dc_replace
+
+        return [
+            dc_replace(
+                r,
+                behavior=Behavior(int(r.behavior) & ~int(Behavior.GLOBAL)),
+            )
+            if (
+                has_behavior(r.behavior, Behavior.GLOBAL)
+                and self.sketch_backend.handles(r)
+            )
+            else r
+            for r in reqs
+        ]
+
     # ------------------------------------------------------------------
     # client API
     # ------------------------------------------------------------------
@@ -296,27 +322,7 @@ class Service:
         local_owner_meta: List[Optional[str]] = []
         forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
 
-        # Sketch-tier names don't compose with GLOBAL replication (the
-        # sketch is not broadcast); strip the flag so such requests route
-        # plainly to the key's owner and are counted ONCE there instead of
-        # locally-plus-forwarded (double counting).
-        if self.sketch_backend is not None:
-            from dataclasses import replace as dc_replace
-
-            reqs = [
-                dc_replace(
-                    r,
-                    behavior=Behavior(
-                        int(r.behavior) & ~int(Behavior.GLOBAL)
-                    ),
-                )
-                if (
-                    has_behavior(r.behavior, Behavior.GLOBAL)
-                    and self.sketch_backend.handles(r)
-                )
-                else r
-                for r in reqs
-            ]
+        reqs = self._strip_sketch_global(reqs)
 
         engine_idx: List[int] = []
 
@@ -491,7 +497,14 @@ class Service:
             try:
                 self.metrics.getratelimit_counter.labels("forward").inc()
                 resp = await peer.get_peer_rate_limit(req)
-                resp.metadata = {"owner": peer.info().grpc_address}
+                # The reference replaces metadata wholesale with the owner
+                # annotation (gubernator.go:281,406), but its responses
+                # never carry other metadata, so merging is observably
+                # identical there — and it preserves the sketch tier's
+                # "tier" tag (no reference analog) across forwards.
+                md = dict(resp.metadata) if resp.metadata else {}
+                md["owner"] = peer.info().grpc_address
+                resp.metadata = md
                 return resp
             except PeerNotReadyError as e:
                 last_err = e
@@ -533,6 +546,11 @@ class Service:
                 "'PeerRequest.rate_limits' list too large; max size is '%d'"
                 % MAX_BATCH_SIZE,
             )
+        # Forwarders normally strip GLOBAL from sketch-tier names before
+        # sending, but zero-copy forwards (the compiled lane) splice the
+        # client's original bytes — re-strip here so a GLOBAL+sketch
+        # request never queues an exact-table broadcast for a sketch key.
+        reqs = self._strip_sketch_global(reqs)
         return await self._check_local(reqs)
 
     async def update_peer_globals(

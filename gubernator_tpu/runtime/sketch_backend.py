@@ -16,7 +16,7 @@ Semantics differences from the exact tier, by design:
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,6 +46,49 @@ class SketchBackend:
 
     def handles(self, req: RateLimitReq) -> bool:
         return req.name in self.cfg.names
+
+    def check_cols(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limits: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Columnar check for the compiled fast lane: int64 fingerprint /
+        hits / limit arrays in, (status, remaining, reset_time) int64
+        arrays out.  Same decision semantics as check() without
+        per-request objects; validation happens upstream (the wire
+        parser's err column excludes errored lanes)."""
+        n = len(key_hash)
+        status = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        now = self.clock.millisecond_now()
+        window_ms = self.cfg.window_ms
+        for lo in range(0, n, self.batch):
+            hi = min(lo + self.batch, n)
+            pad = self.batch - (hi - lo)
+            kh = np.concatenate(
+                [key_hash[lo:hi], np.zeros(pad, dtype=np.int64)]
+            )
+            hc = np.concatenate(
+                [hits[lo:hi], np.zeros(pad, dtype=np.int64)]
+            ).astype(np.int32)
+            lc = np.concatenate(
+                [limits[lo:hi], np.zeros(pad, dtype=np.int64)]
+            ).astype(np.int32)
+            with self._lock:
+                self.state, over, est = self._step(
+                    self.state, kh, hc, lc, np.int64(now)
+                )
+            over = np.asarray(over)[: hi - lo]
+            est = np.asarray(est)[: hi - lo].astype(np.int64)
+            win_start = int(np.asarray(self.state.window_start))
+            status[lo:hi] = over.astype(np.int64)  # 1 = OVER_LIMIT
+            remaining[lo:hi] = np.maximum(
+                0, limits[lo:hi] - est - np.maximum(hits[lo:hi], 0)
+            )
+            reset[lo:hi] = win_start + window_ms
+        return status, remaining, reset
 
     def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
         from gubernator_tpu import native

@@ -273,16 +273,21 @@ int64_t gub_count_reqs(const uint8_t* buf, int64_t len) {
 // Parse the payload into per-request columns.  err[i]: 0 ok, 1 empty
 // unique_key, 2 empty name (matching the service's validation order and
 // messages).  hash[i] = XXH64(name + "_" + unique_key) with 0 remapped to 1;
-// 0 on errored requests.  msg_off/msg_len give each RateLimitReq's frame
-// (tag byte + length varint + body) within the payload, so a router can
-// splice request bytes verbatim into a peer-forward payload without
-// re-encoding.  Returns the parsed count, or -1 on malformed input
-// (callers fall back to the python-protobuf path for the real error).
-int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
-                       int64_t* hash, int32_t* err, int64_t* hits,
-                       int64_t* limit, int64_t* duration, int32_t* algo,
-                       int64_t* behavior, int64_t* burst,
-                       int64_t* msg_off, int64_t* msg_len) {
+// 0 on errored requests.  name_hash[i] = XXH64(name) with 0 remapped to 1
+// (0 when the name is empty) — the columnar route key for name-scoped
+// tiers (the sketch tier routes by this the same way the slot table keys
+// by the 64-bit request fingerprint).  msg_off/msg_len give each
+// RateLimitReq's frame (tag byte + length varint + body) within the
+// payload, so a router can splice request bytes verbatim into a
+// peer-forward payload without re-encoding.  Returns the parsed count, or
+// -1 on malformed input (callers fall back to the python-protobuf path
+// for the real error).
+int64_t gub_parse_reqs2(const uint8_t* buf, int64_t len, int64_t cap,
+                        int64_t* hash, int32_t* err, int64_t* hits,
+                        int64_t* limit, int64_t* duration, int32_t* algo,
+                        int64_t* behavior, int64_t* burst,
+                        int64_t* msg_off, int64_t* msg_len,
+                        int64_t* name_hash) {
   const uint8_t* p = buf;
   const uint8_t* end = buf + len;
   int64_t n = 0;
@@ -348,6 +353,13 @@ int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
     algo[n] = f_algo;
     behavior[n] = f_behavior;
     burst[n] = f_burst;
+    if (name_len == 0) {
+      name_hash[n] = 0;
+    } else {
+      uint64_t nh = xxh64(name, name_len);
+      if (nh == 0) nh = 1;
+      name_hash[n] = (int64_t)nh;
+    }
     if (key_len == 0) {
       err[n] = 1;
       hash[n] = 0;
@@ -373,11 +385,18 @@ int64_t gub_parse_reqs(const uint8_t* buf, int64_t len, int64_t cap,
 // columns (status=1 limit=2 remaining=3 reset_time=4 error=5); the router
 // uses this to merge peer-forwarded responses back into its output
 // columns.  err_off/err_len index INTO the payload (zero len = no error).
-// Returns the item count, or -1 on malformed input.
-int64_t gub_parse_resps(const uint8_t* buf, int64_t len, int64_t cap,
-                        int64_t* status, int64_t* limit, int64_t* remaining,
-                        int64_t* reset_time, int64_t* err_off,
-                        int64_t* err_len) {
+// meta_off/meta_len cover the item's metadata map entries (field 6) as
+// raw wire frames — tag + length + body — so a forwarder can splice the
+// owner's metadata verbatim into its own response.  Serializers write
+// map entries contiguously; if an item's entries are fragmented by an
+// interleaved field, meta_len is -1 (caller drops the metadata rather
+// than splicing unrelated bytes).  Returns the item count, or -1 on
+// malformed input.
+int64_t gub_parse_resps2(const uint8_t* buf, int64_t len, int64_t cap,
+                         int64_t* status, int64_t* limit, int64_t* remaining,
+                         int64_t* reset_time, int64_t* err_off,
+                         int64_t* err_len, int64_t* meta_off,
+                         int64_t* meta_len) {
   const uint8_t* p = buf;
   const uint8_t* end = buf + len;
   int64_t n = 0;
@@ -396,7 +415,10 @@ int64_t gub_parse_resps(const uint8_t* buf, int64_t len, int64_t cap,
     p = qend;
     status[n] = limit[n] = remaining[n] = reset_time[n] = 0;
     err_off[n] = err_len[n] = 0;
+    meta_off[n] = meta_len[n] = 0;
+    const uint8_t* meta_end = nullptr;
     while (q < qend) {
+      const uint8_t* field_start = q;
       uint64_t t;
       if (!get_varint(q, qend, &t)) return -1;
       uint32_t field = (uint32_t)(t >> 3);
@@ -416,6 +438,19 @@ int64_t gub_parse_resps(const uint8_t* buf, int64_t len, int64_t cap,
         err_off[n] = (int64_t)(q - buf);
         err_len[n] = (int64_t)l;
         q += l;
+      } else if (wire == 2 && field == 6) {
+        uint64_t l;
+        if (!get_varint(q, qend, &l) || (uint64_t)(qend - q) < l) return -1;
+        q += l;
+        if (meta_len[n] == 0) {
+          meta_off[n] = (int64_t)(field_start - buf);
+          meta_len[n] = (int64_t)(q - field_start);
+        } else if (meta_len[n] > 0 && field_start == meta_end) {
+          meta_len[n] += (int64_t)(q - field_start);
+        } else {
+          meta_len[n] = -1;  // fragmented — caller drops
+        }
+        meta_end = q;
       } else {
         if (!skip_field(q, qend, wire)) return -1;
       }
@@ -444,36 +479,35 @@ static inline void put_varint(uint8_t*& w, uint64_t v) {
 
 // Emit GetRateLimitsResp (or GetPeerRateLimitsResp) bytes from packed
 // response columns.  err_blob/err_off carry per-request error strings
-// (err_off[i]..err_off[i+1]); zero-length means no error.  owner_blob/
-// owner_off (may be null) carry a per-request "owner" metadata value —
-// the forwarded-response annotation (gubernator.go asyncRequests).
-// Zero-valued fields are omitted like proto3 requires.  Returns bytes
-// written, or -1 if `cap` is too small.
-int64_t gub_serialize_resps(int64_t n, const int64_t* status,
-                            const int64_t* limit, const int64_t* remaining,
-                            const int64_t* reset_time,
-                            const uint8_t* err_blob, const int64_t* err_off,
-                            const uint8_t* owner_blob,
-                            const int64_t* owner_off,
-                            uint8_t* out, int64_t cap) {
+// (err_off[i]..err_off[i+1]); zero-length means no error.  meta_blob/
+// meta_off (may be null) carry per-request PRE-ENCODED metadata map
+// entries — complete field-6 wire frames (tag + length + body), one or
+// more per item, copied into the body verbatim.  Callers build frames
+// with the python helper (meta_frame) or splice them from a parsed
+// response's meta span — this covers the forwarded-response "owner"
+// annotation (gubernator.go asyncRequests) and the sketch tier's
+// "tier" tag with one mechanism.  Zero-valued fields are omitted like
+// proto3 requires.  Returns bytes written, or -1 if `cap` is too small.
+int64_t gub_serialize_resps2(int64_t n, const int64_t* status,
+                             const int64_t* limit, const int64_t* remaining,
+                             const int64_t* reset_time,
+                             const uint8_t* err_blob, const int64_t* err_off,
+                             const uint8_t* meta_blob,
+                             const int64_t* meta_off,
+                             uint8_t* out, int64_t cap) {
   uint8_t* w = out;
   uint8_t* wend = out + cap;
   for (int64_t i = 0; i < n; i++) {
     uint64_t elen = (uint64_t)(err_off[i + 1] - err_off[i]);
-    uint64_t olen =
-        owner_off ? (uint64_t)(owner_off[i + 1] - owner_off[i]) : 0;
+    uint64_t mlen =
+        meta_off ? (uint64_t)(meta_off[i + 1] - meta_off[i]) : 0;
     size_t body = 0;
     if (status[i]) body += 1 + varint_size((uint64_t)status[i]);
     if (limit[i]) body += 1 + varint_size((uint64_t)limit[i]);
     if (remaining[i]) body += 1 + varint_size((uint64_t)remaining[i]);
     if (reset_time[i]) body += 1 + varint_size((uint64_t)reset_time[i]);
     if (elen) body += 1 + varint_size(elen) + elen;
-    size_t entry = 0;
-    if (olen) {
-      // map<string,string> entry: key=1 ("owner"), value=2.
-      entry = (1 + 1 + 5) + (1 + varint_size(olen) + olen);
-      body += 1 + varint_size(entry) + entry;
-    }
+    body += mlen;
     size_t total = 1 + varint_size(body) + body;
     if ((size_t)(wend - w) < total) return -1;
     *w++ = 0x0A;  // field 1, wire 2
@@ -500,17 +534,9 @@ int64_t gub_serialize_resps(int64_t n, const int64_t* status,
       std::memcpy(w, err_blob + err_off[i], elen);
       w += elen;
     }
-    if (olen) {
-      *w++ = 0x32;  // field 6 (metadata), wire 2
-      put_varint(w, entry);
-      *w++ = 0x0A;  // map key, wire 2
-      *w++ = 5;
-      std::memcpy(w, "owner", 5);
-      w += 5;
-      *w++ = 0x12;  // map value, wire 2
-      put_varint(w, olen);
-      std::memcpy(w, owner_blob + owner_off[i], olen);
-      w += olen;
+    if (mlen) {
+      std::memcpy(w, meta_blob + meta_off[i], mlen);
+      w += mlen;
     }
   }
   return (int64_t)(w - out);

@@ -444,3 +444,189 @@ def test_multinode_routing_peer_failure_fallback():
         cl.close()
     finally:
         c.stop()
+
+
+# -- sketch tier on the compiled lane --------------------------------------
+
+from gubernator_tpu.core.config import SketchTierConfig  # noqa: E402
+
+SKETCH_TPL = DaemonConfig(
+    sketch=SketchTierConfig(
+        names=["per_ip"], width=1024, window_ms=60_000, batch_size=128
+    )
+)
+
+
+@pytest.fixture(scope="module")
+def sketch_node():
+    """Single daemon with an approximate tier attached — previously the
+    whole service fell off the fast lane; now sketch-named lanes ride it
+    via the parser's name_hash column."""
+    c = Cluster.start(1, conf_template=SKETCH_TPL)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def sketch_client(sketch_node):
+    cl = V1Client(sketch_node.addresses()[0])
+    yield cl
+    cl.close()
+
+
+def test_sketch_lanes_ride_fast_lane(sketch_node, sketch_client):
+    """Mixed exact + sketch batch on the compiled lane: same responses
+    as the object path (tests/test_sketch_tier.py scenario), tier
+    metadata included, no fallback."""
+    fp = _fp(sketch_node)
+    before, fb = fp.served, fp.fallbacks
+    r = sketch_client.get_rate_limits([
+        RateLimitReq(name="per_ip", unique_key="1.2.3.4", hits=2,
+                     limit=5, duration=60_000),
+        RateLimitReq(name="exact", unique_key="acct", hits=1,
+                     limit=10, duration=60_000),
+        RateLimitReq(name="per_ip", unique_key="5.6.7.8", hits=1,
+                     limit=5, duration=60_000),
+    ])
+    assert fp.served == before + 3
+    assert fp.fallbacks == fb
+    assert r[0].metadata.get("tier") == "sketch"
+    assert r[0].status == Status.UNDER_LIMIT
+    assert r[0].remaining == 3
+    assert r[0].limit == 5
+    assert r[0].reset_time > 0
+    assert r[1].metadata.get("tier") is None
+    assert r[1].remaining == 9
+    assert r[2].metadata.get("tier") == "sketch"
+    assert r[2].remaining == 4
+
+    # Drive one IP over its limit; the other stays under.
+    for _ in range(2):
+        r = sketch_client.get_rate_limits([
+            RateLimitReq(name="per_ip", unique_key="1.2.3.4", hits=2,
+                         limit=5, duration=60_000)
+        ])
+    assert r[0].status == Status.OVER_LIMIT
+    r = sketch_client.get_rate_limits([
+        RateLimitReq(name="per_ip", unique_key="5.6.7.8", hits=1,
+                     limit=5, duration=60_000)
+    ])
+    assert r[0].status == Status.UNDER_LIMIT
+
+
+def test_sketch_strips_global_on_fast_lane(sketch_node, sketch_client):
+    """GLOBAL on a sketch name must not queue an exact-table broadcast
+    (the object path's routing strip, service.py)."""
+    fp = _fp(sketch_node)
+    svc = sketch_node.daemons[0].service
+    before = fp.served
+    upd_before = dict(svc.global_mgr._updates)
+    r = sketch_client.get_rate_limits([
+        RateLimitReq(name="per_ip", unique_key="9.9.9.9", hits=1,
+                     limit=5, duration=60_000, behavior=Behavior.GLOBAL),
+    ])
+    assert fp.served == before + 1
+    assert r[0].metadata.get("tier") == "sketch"
+    assert r[0].remaining == 4
+    assert "per_ip_9.9.9.9" not in svc.global_mgr._updates
+    assert svc.global_mgr._updates == upd_before
+
+
+def test_sketch_ignores_gregorian_on_fast_lane(sketch_node, sketch_client):
+    """The sketch tier ignores duration entirely, so an out-of-range
+    Gregorian duration must NOT error a sketch lane (SketchBackend.check
+    never computes it) — while an exact lane with the same duration
+    does."""
+    r = sketch_client.get_rate_limits([
+        RateLimitReq(name="per_ip", unique_key="g", hits=1, limit=5,
+                     duration=99, behavior=Behavior.DURATION_IS_GREGORIAN),
+        RateLimitReq(name="exact", unique_key="g", hits=1, limit=5,
+                     duration=99, behavior=Behavior.DURATION_IS_GREGORIAN),
+    ])
+    assert r[0].error == ""
+    assert r[0].metadata.get("tier") == "sketch"
+    assert r[1].error != ""
+
+
+def test_sketch_forwarded_keeps_tier_and_owner_metadata():
+    """Multi-node: sketch lanes route to the key's owner like plain
+    lanes; the forwarder splices the owner's tier metadata verbatim and
+    appends its own owner annotation."""
+    c = Cluster.start(3, conf_template=SKETCH_TPL)
+    try:
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        keys = [f"10.0.0.{i}" for i in range(40)]
+        reqs = [
+            RateLimitReq(name="per_ip", unique_key=k, hits=1, limit=10,
+                         duration=60_000)
+            for k in keys
+        ]
+        rs = cl.get_rate_limits(reqs)
+        assert fp.served == len(keys)
+        assert fp.fallbacks == 0
+        assert all(x.error == "" for x in rs)
+        assert all(x.metadata.get("tier") == "sketch" for x in rs)
+        me = c.daemons[0].advertise_address()
+        others = {d.advertise_address() for d in c.daemons[1:]}
+        forwarded = [x for x in rs if "owner" in x.metadata]
+        local = [x for x in rs if "owner" not in x.metadata]
+        assert forwarded and local  # keys spread over 3 nodes
+        assert {x.metadata["owner"] for x in forwarded} <= others
+        assert me not in {x.metadata.get("owner") for x in forwarded}
+        # Each owner counted its keys on ITS sketch: re-sending the same
+        # traffic decrements remaining everywhere (state lives at the
+        # owner, once per key).
+        rs2 = cl.get_rate_limits(reqs)
+        assert all(x.remaining == y.remaining - 1 for x, y in zip(rs2, rs))
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_native_name_hash_and_meta_frames():
+    """Wire-codec invariants for the sketch route key and metadata
+    splicing: name_hash == XXH64(name), and pre-encoded meta frames
+    round-trip through serialize -> parse with the span preserved."""
+    import numpy as np
+
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    req = pb.GetRateLimitsReq()
+    req.requests.add(name="per_ip", unique_key="k1", hits=1, limit=5,
+                     duration=1000)
+    req.requests.add(name="other", unique_key="k2", hits=1, limit=5,
+                     duration=1000)
+    cols = native.parse_reqs(req.SerializeToString())
+    assert cols is not None
+    want = native.hash_keys(["per_ip", "other"])
+    assert list(cols.name_hash) == list(want)
+
+    frame = native.meta_frame(b"tier", b"sketch")
+    frames = [frame + native.meta_frame(b"owner", b"h:81"), b"", frame]
+    off = np.zeros(4, dtype=np.int64)
+    np.cumsum([len(f) for f in frames], out=off[1:])
+    raw = native.serialize_resps(
+        np.array([1, 0, 0], dtype=np.int64),
+        np.array([5, 5, 5], dtype=np.int64),
+        np.array([0, 1, 2], dtype=np.int64),
+        np.array([9, 9, 9], dtype=np.int64),
+        b"", np.zeros(4, dtype=np.int64),
+        b"".join(frames), off,
+    )
+    # python-protobuf agrees on the metadata content...
+    resp = pb.GetRateLimitsResp.FromString(raw)
+    assert dict(resp.responses[0].metadata) == {
+        "tier": "sketch", "owner": "h:81"
+    }
+    assert dict(resp.responses[1].metadata) == {}
+    assert dict(resp.responses[2].metadata) == {"tier": "sketch"}
+    # ...and the columnar parser recovers each item's exact frame span.
+    rc = native.parse_resps(raw)
+    assert rc is not None and rc.n == 3
+    for j, f in enumerate(frames):
+        got = (
+            raw[int(rc.meta_off[j]):int(rc.meta_off[j]) + int(rc.meta_len[j])]
+            if rc.meta_len[j] > 0 else b""
+        )
+        assert got == f, j
