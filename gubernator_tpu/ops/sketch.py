@@ -10,18 +10,25 @@ Design (TPU-first):
 - State is two [D, W] int32 sketches — current and previous window — plus
   the window index.  Estimated rate = cur + prev * overlap_fraction, the
   standard sliding-window approximation.
-- The per-batch update/read is expressed as ONE-HOT MATMULS: each key's D
-  bucket columns become one-hot rows; `one_hot.T @ hits` scatters the adds
-  and `one_hot @ sketch[d]` gathers the reads — both ride the MXU instead
-  of fighting serialized HBM scatter.  W is sized to VMEM (<= 32768), which
-  a CMS permits: its error bound e*N/W depends on window DECISION volume N,
-  not key count.
+- The hot step (cms_step) is GATHER/SCATTER: take_along_axis reads the D
+  bucket cells per key and `.at[].add` applies the hits; the window
+  rotation is gated behind `lax.cond` so the steady state (inside a
+  window) never rewrites the [D, W] tables.  Measured on TPU this beats
+  the one-hot-matmul formulation at EVERY width — 3x at W=8192 and ~600x
+  at W=2^20 (0.08 ms/step at batch 4096, ~48M checks/s device-side):
+  the one-hot path materializes [D, B, W] intermediates and the
+  ungated rotation streams the full tables through HBM every step,
+  while the scatter path touches D*B cells.
+- Large widths are therefore practical: W is bounded by HBM, not VMEM,
+  though a CMS rarely needs it — its error bound e*N/W depends on window
+  DECISION volume N, not key count.
 - Row hashes are derived on device from the key fingerprint with D odd
   multipliers + shifts (multiply-shift hashing) — no host round trips.
 
-The pure-XLA implementation below is the semantic reference; the fused
-Pallas kernel (ops/pallas/cms_kernel.py) implements the same contract for
-the hot path and is differentially tested against this.
+cms_step_impl (one-hot matmuls over the MXU, ungated rotation) is kept
+as the independently-derived SEMANTIC REFERENCE: both the scatter step
+and the fused Pallas kernel (ops/pallas/cms_kernel.py) are
+differentially tested bit-exact against it.
 
 No reference analog: gubernator keeps exact state only and simply evicts
 under pressure (lrucache.go:147-158), silently over-admitting at scale;
@@ -173,13 +180,82 @@ def cms_step_impl(
     )
 
 
-cms_step = jax.jit(cms_step_impl, donate_argnums=(0,))
+# The semantic reference, jitted (differential tests drive this).
+cms_step_onehot = jax.jit(cms_step_impl, donate_argnums=(0,))
 
 
-def make_cms_step(use_pallas: bool = False):
-    """Step factory: the XLA path or the fused Pallas kernel."""
-    if not use_pallas:
-        return cms_step
-    from gubernator_tpu.ops.pallas.cms_kernel import cms_step_pallas
+def _rotate_cond(
+    state: SketchState, now: jax.Array
+) -> Tuple[SketchState, jax.Array]:
+    """_rotate with the table rewrite gated behind lax.cond: the steady
+    state (now inside the current window) costs two scalar compares
+    instead of streaming both [D, W] tables through HBM.  Bit-identical
+    outcomes to _rotate (differentially tested)."""
+    now = jnp.asarray(now, dtype=jnp.int64)
+    elapsed = now - state.window_start
+    w = state.window_ms
 
-    return cms_step_pallas
+    def stay(s: SketchState) -> SketchState:
+        return s
+
+    def roll(s: SketchState) -> SketchState:
+        one_behind = (elapsed >= w) & (elapsed < 2 * w)
+        z = jnp.zeros_like(s.cur)
+        return SketchState(
+            cur=z,
+            prev=jnp.where(one_behind, s.cur, z),
+            window_start=now - (elapsed % w),
+            window_ms=s.window_ms,
+        )
+
+    state = jax.lax.cond(elapsed < w, stay, roll, state)
+    frac = (
+        1.0
+        - (now - state.window_start).astype(jnp.float32)
+        / w.astype(jnp.float32)
+    )
+    return state, jnp.clip(frac, 0.0, 1.0)
+
+
+def cms_step_scatter_impl(
+    state: SketchState,
+    key_hash: jax.Array,
+    hits: jax.Array,
+    limit: jax.Array,
+    now: jax.Array,
+) -> Tuple[SketchState, jax.Array, jax.Array]:
+    """The hot-path step: gather reads + scatter adds, bit-exact against
+    cms_step_impl (see the module docstring for the measured rationale).
+
+    Duplicate keys in one batch behave identically to the reference:
+    `.at[].add` sums same-cell hits the way the one-hot matmul does, and
+    every duplicate lane reads the shared pre-batch estimate."""
+    depth, width = state.cur.shape
+    state, overlap = _rotate_cond(state, now)
+    active = key_hash != 0
+    cols = row_columns(key_hash, depth, width)            # [D, B]
+
+    rc = jnp.take_along_axis(state.cur, cols, axis=1)
+    rp = jnp.take_along_axis(state.prev, cols, axis=1)
+    reads = rc.astype(jnp.float32) + rp.astype(jnp.float32) * overlap
+    estimate = jnp.where(active, jnp.min(reads, axis=0), 0.0)  # [B]
+
+    over = active & (
+        estimate + hits.astype(jnp.float32)
+        > limit.astype(jnp.float32)
+    ) & (hits > 0)
+
+    add = jnp.where(active, hits, 0).astype(jnp.int32)    # [B]
+    d_idx = jnp.broadcast_to(jnp.arange(depth)[:, None], cols.shape)
+    new_cur = state.cur.at[d_idx, cols].add(
+        jnp.broadcast_to(add[None, :], cols.shape)
+    )
+
+    return (
+        SketchState(new_cur, state.prev, state.window_start, state.window_ms),
+        over,
+        estimate.astype(jnp.int32),
+    )
+
+
+cms_step = jax.jit(cms_step_scatter_impl, donate_argnums=(0,))

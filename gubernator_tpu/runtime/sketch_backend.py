@@ -7,6 +7,14 @@ of exact slots.  Memory is O(depth*width) regardless of key count — the
 100M-key tier (BASELINE.json) — at the cost of bounded over-limiting of
 hot-colliding keys (never under-limiting).
 
+Dispatch discipline (the exact lane's, runtime/fastpath.py): a whole
+merge — any size — is ONE device dispatch (chunks ride a lax.scan on
+device), issued under the lock with the response sync OUTSIDE it, so
+concurrent merges pipeline against each other's device round-trips
+instead of serializing blocking reads.  `window_start` is mirrored on
+host with the same rotation arithmetic the kernel applies, so building
+`reset_time` costs no device read-back.
+
 Semantics differences from the exact tier, by design:
 - `remaining` is an estimate (limit - estimated_count, floored at 0);
 - duration selects the sliding window only at tier-config granularity
@@ -16,7 +24,7 @@ Semantics differences from the exact tier, by design:
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -33,19 +41,90 @@ class SketchBackend:
         cfg: SketchTierConfig,
         clock: Optional[clock_mod.Clock] = None,
     ) -> None:
-        from gubernator_tpu.ops.sketch import init_sketch, make_cms_step
+        from gubernator_tpu.ops.sketch import (
+            cms_step_scatter_impl,
+            init_sketch,
+        )
 
         self.cfg = cfg
         self.clock = clock or clock_mod.default_clock()
         self.state = init_sketch(
             depth=cfg.depth, width=cfg.width, window_ms=cfg.window_ms
         )
-        self._step = make_cms_step(use_pallas=cfg.use_pallas)
+        if cfg.use_pallas:
+            from gubernator_tpu.ops.pallas.cms_kernel import (
+                cms_step_pallas_impl,
+            )
+
+            self._impl = cms_step_pallas_impl
+        else:
+            self._impl = cms_step_scatter_impl
         self._lock = threading.Lock()
+        self._compile_lock = threading.Lock()
         self.batch = cfg.batch_size
+        # Host mirror of state.window_start (ms), advanced with the same
+        # arithmetic as the kernel's rotation (ops/sketch.py _rotate) —
+        # reset_time needs no device read-back.
+        self._win_start = 0
+        # k (chunk count) -> jitted multi-chunk step; k is rounded up to
+        # a power of two so merge-size jitter costs O(log) compiles.
+        self._multi: Dict[int, object] = {}
 
     def handles(self, req: RateLimitReq) -> bool:
         return req.name in self.cfg.names
+
+    def _advance_window(self, now_ms: int) -> None:
+        """The kernel's rotation arithmetic on the host mirror (called
+        under the lock, with the same `now` the dispatch uses)."""
+        w = self.cfg.window_ms
+        elapsed = now_ms - self._win_start
+        if elapsed >= w:
+            self._win_start = now_ms - (elapsed % w)
+
+    def _multi_step(self, k: int):
+        """Jitted scan over k chunks: ONE dispatch per merge, chunks
+        applied in order on device (each sees the previous chunk's adds,
+        the same sequencing the per-chunk host loop had).  Returns
+        (state', packed int32[k, 2, B]) — over/est stacked so the whole
+        response is one transfer.
+
+        The first merge at a new k compiles OUTSIDE the dispatch lock
+        (against a throwaway state), so concurrent merges never stall on
+        an XLA compile — callers fetch the step before taking _lock."""
+        fn = self._multi.get(k)
+        if fn is not None:
+            return fn
+        with self._compile_lock:
+            fn = self._multi.get(k)
+            if fn is not None:
+                return fn
+            import jax
+            import jax.numpy as jnp
+
+            from gubernator_tpu.ops.sketch import init_sketch
+
+            impl = self._impl
+
+            def multi(state, kh, hits, lim, now):
+                def body(st, xs):
+                    khr, hr, lr = xs
+                    st, over, est = impl(st, khr, hr, lr, now)
+                    return st, jnp.stack([over.astype(jnp.int32), est])
+
+                st, packed = jax.lax.scan(body, state, (kh, hits, lim))
+                return st, packed
+
+            fn = jax.jit(multi, donate_argnums=(0,))
+            warm_state = init_sketch(
+                depth=self.cfg.depth, width=self.cfg.width,
+                window_ms=self.cfg.window_ms,
+            )
+            z64 = np.zeros((k, self.batch), dtype=np.int64)
+            z32 = np.zeros((k, self.batch), dtype=np.int32)
+            st, packed = fn(warm_state, z64, z32, z32, np.int64(0))
+            np.asarray(packed)  # block until the compile finishes
+            self._multi[k] = fn
+        return fn
 
     def check_cols(
         self,
@@ -53,41 +132,40 @@ class SketchBackend:
         hits: np.ndarray,
         limits: np.ndarray,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Columnar check for the compiled fast lane: int64 fingerprint /
-        hits / limit arrays in, (status, remaining, reset_time) int64
-        arrays out.  Same decision semantics as check() without
-        per-request objects; validation happens upstream (the wire
-        parser's err column excludes errored lanes)."""
+        """Columnar check (the fast lane and check()'s core): int64
+        fingerprint / hits / limit arrays in, (status, remaining,
+        reset_time) int64 arrays out.  Validation happens upstream (the
+        wire parser's err column / check()'s request validation)."""
         n = len(key_hash)
-        status = np.zeros(n, dtype=np.int64)
-        remaining = np.zeros(n, dtype=np.int64)
-        reset = np.zeros(n, dtype=np.int64)
-        now = self.clock.millisecond_now()
-        window_ms = self.cfg.window_ms
-        for lo in range(0, n, self.batch):
-            hi = min(lo + self.batch, n)
-            pad = self.batch - (hi - lo)
-            kh = np.concatenate(
-                [key_hash[lo:hi], np.zeros(pad, dtype=np.int64)]
-            )
-            hc = np.concatenate(
-                [hits[lo:hi], np.zeros(pad, dtype=np.int64)]
-            ).astype(np.int32)
-            lc = np.concatenate(
-                [limits[lo:hi], np.zeros(pad, dtype=np.int64)]
-            ).astype(np.int32)
-            with self._lock:
-                self.state, over, est = self._step(
-                    self.state, kh, hc, lc, np.int64(now)
-                )
-            over = np.asarray(over)[: hi - lo]
-            est = np.asarray(est)[: hi - lo].astype(np.int64)
-            win_start = int(np.asarray(self.state.window_start))
-            status[lo:hi] = over.astype(np.int64)  # 1 = OVER_LIMIT
-            remaining[lo:hi] = np.maximum(
-                0, limits[lo:hi] - est - np.maximum(hits[lo:hi], 0)
-            )
-            reset[lo:hi] = win_start + window_ms
+        B = self.batch
+        k = 1
+        while k * B < n:
+            k <<= 1
+        pad = k * B - n
+        kh = np.concatenate(
+            [key_hash, np.zeros(pad, dtype=np.int64)]
+        ).reshape(k, B)
+        hc = np.concatenate(
+            [hits, np.zeros(pad, dtype=np.int64)]
+        ).astype(np.int32).reshape(k, B)
+        lc = np.concatenate(
+            [limits, np.zeros(pad, dtype=np.int64)]
+        ).astype(np.int32).reshape(k, B)
+        step = self._multi_step(k)  # compiles outside the dispatch lock
+        with self._lock:
+            now = self.clock.millisecond_now()
+            self._advance_window(int(now))
+            reset_val = self._win_start + self.cfg.window_ms
+            self.state, packed = step(self.state, kh, hc, lc, np.int64(now))
+        # Response sync OUTSIDE the lock: `packed` is this call's own
+        # output buffer (only the state is donated), so later dispatches
+        # can't touch it — merges pipeline like the exact lane.
+        out = np.asarray(packed)
+        over = out[:, 0, :].reshape(-1)[:n]
+        est = out[:, 1, :].reshape(-1)[:n].astype(np.int64)
+        status = over.astype(np.int64)
+        remaining = np.maximum(0, limits - est - np.maximum(hits, 0))
+        reset = np.full(n, reset_val, dtype=np.int64)
         return status, remaining, reset
 
     def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
@@ -118,43 +196,22 @@ class SketchBackend:
             return out_all
 
         n = len(reqs)
-        now = self.clock.millisecond_now()
-        hashes_all = native.hash_keys([r.hash_key() for r in reqs])
-        out: List[RateLimitResp] = []
-        window_ms = self.cfg.window_ms
-        for lo in range(0, n, self.batch):
-            chunk = reqs[lo:lo + self.batch]
-            pad = self.batch - len(chunk)
-            kh = np.concatenate(
-                [hashes_all[lo:lo + self.batch],
-                 np.zeros(pad, dtype=np.int64)]
+        if n == 0:
+            return []
+        kh = native.hash_keys([r.hash_key() for r in reqs])
+        hits = np.array([r.hits for r in reqs], dtype=np.int64)
+        limits = np.array([r.limit for r in reqs], dtype=np.int64)
+        status, remaining, reset = self.check_cols(kh, hits, limits)
+        return [
+            RateLimitResp(
+                status=(
+                    Status.OVER_LIMIT if status[j]
+                    else Status.UNDER_LIMIT
+                ),
+                limit=int(limits[j]),
+                remaining=int(remaining[j]),
+                reset_time=int(reset[j]),
+                metadata={"tier": "sketch"},
             )
-            hits = np.array(
-                [r.hits for r in chunk] + [0] * pad, dtype=np.int32
-            )
-            limits = np.array(
-                [r.limit for r in chunk] + [0] * pad, dtype=np.int32
-            )
-            with self._lock:
-                self.state, over, est = self._step(
-                    self.state, kh, hits, limits, np.int64(now)
-                )
-            over = np.asarray(over)
-            est = np.asarray(est)
-            win_start = int(np.asarray(self.state.window_start))
-            reset = win_start + window_ms
-            for j, r in enumerate(chunk):
-                e = int(est[j])
-                out.append(
-                    RateLimitResp(
-                        status=(
-                            Status.OVER_LIMIT if over[j]
-                            else Status.UNDER_LIMIT
-                        ),
-                        limit=r.limit,
-                        remaining=max(0, r.limit - e - max(r.hits, 0)),
-                        reset_time=reset,
-                        metadata={"tier": "sketch"},
-                    )
-                )
-        return out
+            for j in range(n)
+        ]
