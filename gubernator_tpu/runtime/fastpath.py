@@ -27,10 +27,11 @@ Eligibility — anything else falls back to the object path, which remains
 the semantic reference:
   - native library loadable;
   - no Store / Loader attached (their hooks are per-key);
-  - no MULTI_REGION behaviors in the batch (they route through the
-    manager).  GLOBAL is served HERE — use_cached lanes for non-owned
-    reads, queued hits/updates for the managers — except when the mesh
-    GlobalEngine owns it (ICI-collective path);
+  - GLOBAL is served HERE — use_cached lanes for non-owned reads,
+    queued hits/updates for the managers — except when the mesh
+    GlobalEngine owns it (ICI-collective path); MULTI_REGION serves
+    like a plain lane with owner-side hits queued to the region
+    manager (one decode per unique key);
   - sketch-tier names are served HERE too: the parser's name_hash
     column routes them to SketchBackend.check_cols (one CMS step per
     merge), with GLOBAL stripped exactly like the object path's
@@ -64,11 +65,8 @@ _ERR_EMPTY_KEY = b"field 'unique_key' cannot be empty"
 _ERR_EMPTY_NAME = b"field 'namespace' cannot be empty"
 _ERR_GREG = 3  # parse err code for host-side Gregorian failures
 
-# MULTI_REGION still routes through the managers on the object path;
-# GLOBAL is served on the compiled lane (use_cached lanes + queued
-# hits/updates) except when the mesh GlobalEngine owns it.
-_SKIP_MASK = int(Behavior.MULTI_REGION)
 _GLOBAL = int(Behavior.GLOBAL)
+_MULTI_REGION = int(Behavior.MULTI_REGION)
 
 # The sketch tier's response annotation (object path: metadata
 # {"tier": "sketch"}, runtime/sketch_backend.py).
@@ -180,9 +178,6 @@ class FastPath:
                 "Requests.RateLimits list too large; max size is '%d'"
                 % MAX_BATCH_SIZE,
             )
-        if n and (cols.behavior & _SKIP_MASK).any():
-            self.fallbacks += 1
-            return None
         sk: Optional[np.ndarray] = None
         if self.s.sketch_backend is not None and n:
             sk = np.isin(cols.name_hash, self._sketch_hashes()) & (
@@ -288,24 +283,18 @@ class FastPath:
         await self._queue.put(entry)
         return await entry.fut
 
-    def _queue_global(self, payload, cols, idx, as_update: bool) -> None:
-        """Queue GLOBAL hits (non-owner) or broadcast updates (owner) for
-        the request indices `idx` — the deferred QueueHit/QueueUpdate of
-        gubernator.go:429-432/617.  One decode per UNIQUE key with summed
-        hits (the manager aggregates by key anyway, global.go:87-95)."""
-        from dataclasses import replace as dc_replace
-
+    def _decode_unique(self, payload, cols, idx):
+        """Yield (req, group_indices) for each UNIQUE key hash among the
+        request indices `idx` — one protobuf decode per unique key (the
+        managers aggregate by key anyway, global.go:87-95)."""
         from gubernator_tpu.net.grpc_api import req_from_pb
         from gubernator_tpu.proto import gubernator_pb2 as pb
 
-        if not len(idx):
-            return
         order = idx[np.argsort(cols.hash[idx], kind="stable")]
         hs = cols.hash[order]
         bounds = np.flatnonzero(
             np.concatenate([[True], hs[1:] != hs[:-1]])
         )
-        mgr = self.s.global_mgr
         for b_i, lo in enumerate(bounds):
             hi = bounds[b_i + 1] if b_i + 1 < len(bounds) else len(order)
             group = order[lo:hi]
@@ -314,12 +303,36 @@ class FastPath:
                 cols.msg_off[fi]:cols.msg_off[fi] + cols.msg_len[fi]
             ]
             m = pb.GetRateLimitsReq.FromString(frame).requests[0]
-            req = req_from_pb(m)
+            yield req_from_pb(m), group
+
+    def _queue_global(self, payload, cols, idx, as_update: bool) -> None:
+        """Queue GLOBAL hits (non-owner) or broadcast updates (owner) for
+        the request indices `idx` — the deferred QueueHit/QueueUpdate of
+        gubernator.go:429-432/617."""
+        from dataclasses import replace as dc_replace
+
+        if not len(idx):
+            return
+        mgr = self.s.global_mgr
+        for req, group in self._decode_unique(payload, cols, idx):
             if as_update:
                 mgr.queue_update(req)
             else:
                 total = int(cols.hits[group].sum())
                 mgr.queue_hit(dc_replace(req, hits=total))
+
+    def _queue_multiregion(self, payload, cols, idx) -> None:
+        """Queue owner-side MULTI_REGION hits for the request indices
+        `idx` toward the cross-region manager (the object path's
+        queue_hits call in _check_local, gubernator.go:600-631)."""
+        from dataclasses import replace as dc_replace
+
+        if not len(idx):
+            return
+        mgr = self.s.multi_region_mgr
+        for req, group in self._decode_unique(payload, cols, idx):
+            total = int(cols.hits[group].sum())
+            mgr.queue_hits(dc_replace(req, hits=total))
 
     async def _serve_split(
         self, cols, is_greg, ge, gd, use_cached, sk
@@ -397,6 +410,11 @@ class FastPath:
                 payload, cols,
                 np.flatnonzero(is_global & (cols.err == 0)),
                 as_update=True,
+            )
+        mr = (cols.behavior & _MULTI_REGION) != 0
+        if mr.any():
+            self._queue_multiregion(
+                payload, cols, np.flatnonzero(mr & (cols.err == 0))
             )
         errs = self._error_strings(cols, err_extra)
         err_off = np.zeros(n + 1, dtype=np.int64)
@@ -614,6 +632,17 @@ class FastPath:
                 payload, cols,
                 np.flatnonzero(is_global & owned & (cols.err == 0)),
                 as_update=True,
+            )
+
+        mr = (cols.behavior & _MULTI_REGION) != 0
+        if mr.any():
+            # Owner-side queueing only: non-owned lanes were forwarded
+            # (the owner's peer-RPC lane queues them), and non-owned
+            # GLOBAL cached reads don't queue (the object path's
+            # `if cached: continue`, service._check_local).
+            self._queue_multiregion(
+                payload, cols,
+                np.flatnonzero(mr & owned & (cols.err == 0)),
             )
 
         err_off = np.zeros(n + 1, dtype=np.int64)

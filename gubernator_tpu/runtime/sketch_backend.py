@@ -137,6 +137,15 @@ class SketchBackend:
         reset_time) int64 arrays out.  Validation happens upstream (the
         wire parser's err column / check()'s request validation)."""
         n = len(key_hash)
+        # Sketch cells are int32; clamp limits/hits into range ONCE so
+        # the device decision and the host-side `remaining` agree (an
+        # unclamped int64 limit would wrap in the int32 cast below and
+        # flip the decision while `remaining` reported billions left).
+        # A window limit beyond 2^31-1 is outside the tier's design
+        # envelope anyway — the clamp only changes such configs.
+        i32max = np.int64(2**31 - 1)
+        limits = np.minimum(limits, i32max)
+        hits = np.clip(hits, -i32max, i32max)
         B = self.batch
         k = 1
         while k * B < n:

@@ -450,9 +450,13 @@ def test_multinode_routing_peer_failure_fallback():
 
 from gubernator_tpu.core.config import SketchTierConfig  # noqa: E402
 
+# A 1-hour window: the sliding window aligns to wall-clock boundaries
+# (window_start = now - now % window_ms), so cross-RPC remaining
+# assertions with a short window flake whenever the test happens to
+# straddle a boundary and the estimate decays mid-test.
 SKETCH_TPL = DaemonConfig(
     sketch=SketchTierConfig(
-        names=["per_ip"], width=1024, window_ms=60_000, batch_size=128
+        names=["per_ip"], width=1024, window_ms=3_600_000, batch_size=128
     )
 )
 
@@ -630,3 +634,83 @@ def test_native_name_hash_and_meta_frames():
             if rc.meta_len[j] > 0 else b""
         )
         assert got == f, j
+
+
+# -- MULTI_REGION on the compiled lane -------------------------------------
+
+def _record_queue_hits(svc):
+    rec = []
+    orig = svc.multi_region_mgr.queue_hits
+
+    def wrapper(r):
+        rec.append(r)
+        orig(r)
+
+    svc.multi_region_mgr.queue_hits = wrapper
+    return rec
+
+
+def test_multiregion_serves_and_queues_on_fast_lane():
+    """MULTI_REGION lanes serve like plain lanes on the compiled lane,
+    with owner-side hits queued to the region manager — duplicates
+    aggregated to one queued request per unique key (the manager
+    aggregates by key anyway)."""
+    c = Cluster.start(1)
+    try:
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        svc = c.daemons[0].service
+        rec = _record_queue_hits(svc)
+        before = fp.served
+        r = cl.get_rate_limits([
+            RateLimitReq(name="mr", unique_key="a", hits=1, limit=10,
+                         duration=60_000, behavior=Behavior.MULTI_REGION),
+            RateLimitReq(name="mr", unique_key="a", hits=3, limit=10,
+                         duration=60_000, behavior=Behavior.MULTI_REGION),
+            RateLimitReq(name="plain", unique_key="b", hits=1, limit=10,
+                         duration=60_000),
+        ])
+        assert fp.served == before + 3
+        assert [x.error for x in r] == ["", "", ""]
+        # Duplicate-key lanes decremented sequentially like the exact
+        # machinery always does.
+        assert r[0].remaining == 9
+        assert r[1].remaining == 6
+        assert r[2].remaining == 9
+        # ONE queued request for the duplicate group, hits summed; the
+        # plain lane queued nothing.
+        assert len(rec) == 1
+        assert rec[0].unique_key == "a" and rec[0].hits == 4
+    finally:
+        c.stop()
+
+
+def test_multiregion_forwarded_queues_at_owner():
+    """Multi-node: a non-owned MULTI_REGION lane forwards to the owner,
+    which queues the cross-region hit; the forwarder queues nothing."""
+    c = Cluster.start(2)
+    try:
+        cl = V1Client(c.addresses()[0])
+        svc0 = c.daemons[0].service
+        other = c.daemons[1].advertise_address()
+        # Keys owned by daemon 1 (forwarded) and daemon 0 (local).
+        keys = [f"mrfwd{i}" for i in range(30)]
+        remote = [
+            k for k in keys
+            if svc0.get_peer(f"mr_{k}").info().grpc_address == other
+        ]
+        local = [k for k in keys if k not in remote]
+        assert remote and local
+        rec0 = _record_queue_hits(svc0)
+        rec1 = _record_queue_hits(c.daemons[1].service)
+        rs = cl.get_rate_limits([
+            RateLimitReq(name="mr", unique_key=k, hits=1, limit=10,
+                         duration=60_000, behavior=Behavior.MULTI_REGION)
+            for k in keys
+        ])
+        assert all(x.error == "" and x.remaining == 9 for x in rs)
+        assert sorted(r.unique_key for r in rec0) == sorted(local)
+        assert sorted(r.unique_key for r in rec1) == sorted(remote)
+        cl.close()
+    finally:
+        c.stop()
