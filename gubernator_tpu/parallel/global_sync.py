@@ -333,6 +333,44 @@ class GlobalEngine:
             self.sync()
         return [agg_out[j] for j in idx_map]
 
+    def serve_packed(self, rounds, pend_items):
+        """The compiled fast lane's entry: ingest pre-packed use_cached
+        rounds into the replicated cache table and queue pending hits,
+        under ONE lock hold with check()'s ordering (serve, then queue).
+        `pend_items` is [(req, summed_hits, src_dev)] — one per unique
+        key, decoded by the caller.  Returns (round_resps_device,
+        want_sync); the caller fetches responses to host OUTSIDE the
+        lock (merges pipeline) and calls sync() itself when want_sync —
+        matching check()'s after-lock sync call.
+
+        Only valid when no Store/keymap is attached (the fast lane's
+        eligibility gate): the object path's seeding hooks are skipped
+        here."""
+        now = np.int64(self.clock.millisecond_now())
+        with self._lock:
+            resps = []
+            for db in rounds:
+                t = tier_of(db.active, self.b._tiers)
+                batch = jax.device_put(
+                    pack_grid_batch(db)[:, :, :t], self.b._psharding
+                )
+                self.cache_table, r = self._ingest(
+                    self.cache_table, batch, now
+                )
+                resps.append(r)
+            for req, hits, src_dev in pend_items:
+                key = req.hash_key()
+                p = self.pending.get(key)
+                if p is None:
+                    self.pending[key] = _Pending(
+                        req=req, hits=hits, src_dev=src_dev
+                    )
+                else:
+                    p.hits += hits
+                    p.req = req
+            want_sync = len(self.pending) >= self.batch_limit
+        return resps, want_sync
+
     # -- sync path -------------------------------------------------------
     def _seed_from_store_engine(self, agg_reqs, packed, now_ms: int) -> None:
         """Store.get for batch keys with no live row in the replicated

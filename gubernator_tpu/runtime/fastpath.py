@@ -28,10 +28,12 @@ the semantic reference:
   - native library loadable;
   - no Store / Loader attached (their hooks are per-key);
   - GLOBAL is served HERE — use_cached lanes for non-owned reads,
-    queued hits/updates for the managers — except when the mesh
-    GlobalEngine owns it (ICI-collective path); MULTI_REGION serves
-    like a plain lane with owner-side hits queued to the region
-    manager (one decode per unique key);
+    queued hits/updates for the managers, and node-owned lanes on a
+    mesh service ingesting into the collective GlobalEngine's
+    replicated table (client path; the peer RPC keeps RPC-tier
+    semantics like _check_local); MULTI_REGION serves like a plain
+    lane with owner-side hits queued to the region manager (one
+    decode per unique key);
   - sketch-tier names are served HERE too: the parser's name_hash
     column routes them to SketchBackend.check_cols (one CMS step per
     merge), with GLOBAL stripped exactly like the object path's
@@ -192,10 +194,6 @@ class FastPath:
             else:
                 sk = None
         is_global = (cols.behavior & _GLOBAL) != 0
-        if is_global.any() and self.s.global_engine is not None:
-            # Mesh GLOBAL rides the ICI-collective engine (object path).
-            self.fallbacks += 1
-            return None
         if n == 0:
             return b""
         if not peer_rpc:
@@ -209,7 +207,9 @@ class FastPath:
                 return await self._serve_routed(
                     payload, cols, n, is_global, sk
                 )
-            return await self._serve(payload, cols, n, is_global, sk)
+            return await self._serve(
+                payload, cols, n, is_global, sk, peer_rpc
+            )
         finally:
             if not peer_rpc:
                 self.s._inflight_checks -= 1
@@ -290,6 +290,8 @@ class FastPath:
         from gubernator_tpu.net.grpc_api import req_from_pb
         from gubernator_tpu.proto import gubernator_pb2 as pb
 
+        if not len(idx):
+            return
         order = idx[np.argsort(cols.hash[idx], kind="stable")]
         hs = cols.hash[order]
         bounds = np.flatnonzero(
@@ -335,28 +337,35 @@ class FastPath:
             mgr.queue_hits(dc_replace(req, hits=total))
 
     async def _serve_split(
-        self, cols, is_greg, ge, gd, use_cached, sk
+        self, payload, cols, is_greg, ge, gd, use_cached, sk, eng=None
     ) -> Tuple[np.ndarray, ...]:
         """Serve a column set, splitting sketch-named lanes to the CMS
-        step and the rest to the exact machinery; both run concurrently
-        and scatter into full-size response arrays."""
-        if sk is None or not sk.any():
+        step and engine lanes (node-owned GLOBAL on a mesh service) to
+        the collective GlobalEngine; the rest rides the exact machinery.
+        All branches run concurrently and scatter into full-size
+        response arrays."""
+        no_sk = sk is None or not sk.any()
+        no_eng = eng is None or not eng.any()
+        if no_sk and no_eng:
             return await self._serve_cols(
                 cols, is_greg, ge, gd, use_cached=use_cached
             )
         n = cols.n
-        sk_idx = np.flatnonzero(sk)
-        ex_idx = np.flatnonzero(~sk)
+        sk_m = sk if sk is not None else np.zeros(n, dtype=bool)
+        eng_m = eng if eng is not None else np.zeros(n, dtype=bool)
+        sk_idx = np.flatnonzero(sk_m)
+        eng_idx = np.flatnonzero(eng_m)
+        ex_idx = np.flatnonzero(~sk_m & ~eng_m)
         status = np.zeros(n, dtype=np.int64)
         out_lim = np.zeros(n, dtype=np.int64)
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
+        loop = asyncio.get_running_loop()
 
         async def run_sketch() -> None:
             kh = cols.hash[sk_idx]
             hh = cols.hits[sk_idx]
             ll = cols.limit[sk_idx]
-            loop = asyncio.get_running_loop()
             st, rem, rst = await loop.run_in_executor(
                 self._pool,
                 lambda: self.s.sketch_backend.check_cols(kh, hh, ll),
@@ -365,6 +374,23 @@ class FastPath:
             out_lim[sk_idx] = ll
             remaining[sk_idx] = rem
             reset[sk_idx] = rst
+
+        async def run_engine() -> None:
+            st, lm, rem, rst = await loop.run_in_executor(
+                self._pool,
+                lambda: self._engine_cols(
+                    payload, cols, eng_idx, is_greg, ge, gd
+                ),
+            )
+            status[eng_idx] = st
+            out_lim[eng_idx] = lm
+            remaining[eng_idx] = rem
+            reset[eng_idx] = rst
+            # Open the sync window for the queued hits (the object
+            # path's notify at service.py:405; asyncio.Event — must run
+            # on the loop thread, hence here and not in _engine_cols).
+            if self.s._collective_loop is not None:
+                self.s._collective_loop.notify()
 
         async def run_exact() -> None:
             sub = cols.subset(ex_idx)
@@ -379,11 +405,106 @@ class FastPath:
             remaining[ex_idx] = rem
             reset[ex_idx] = rst
 
-        tasks = [run_sketch()]
+        tasks = []
+        if len(sk_idx):
+            tasks.append(run_sketch())
+        if len(eng_idx):
+            tasks.append(run_engine())
         if len(ex_idx):
             tasks.append(run_exact())
         await asyncio.gather(*tasks)
         return status, out_lim, remaining, reset
+
+    def _engine_cols(
+        self, payload, cols, idx, is_greg, ge, gd
+    ) -> Tuple[np.ndarray, ...]:
+        """Columnar serving for node-owned GLOBAL lanes on the mesh
+        GlobalEngine (runs on a fast-lane pool thread).
+
+        Mirrors GlobalEngine.check: duplicates aggregate to ONE lane per
+        unique key (hits summed, first occurrence's params; the response
+        is shared — the engine's documented dedup), lanes route to their
+        arrival device, the ingest runs use_cached on the replicated
+        cache table, and pending hits queue for the next collective
+        sync."""
+        from gubernator_tpu.parallel.sharded import (
+            packed_grid_rounds_to_host,
+        )
+        from gubernator_tpu.runtime.backend import (
+            Tally,
+            tally_from_rounds,
+        )
+
+        engine = self.s.global_engine
+        cfg = self.s.backend.cfg
+        n_shards, B = cfg.num_shards, cfg.batch_size
+        sub_h = cols.hash[idx]
+        uniq, first, inv = np.unique(
+            sub_h, return_index=True, return_inverse=True
+        )
+        rep = idx[first]                       # first occurrence per key
+        m = len(uniq)
+        # Exact int64 sums (float64 bincount weights would corrupt hits
+        # above 2^53 and diverge from the pending queue's exact sums).
+        hits_sum = np.zeros(m, dtype=np.int64)
+        np.add.at(hits_sum, inv, cols.hits[idx])
+        lim = cols.limit[rep]
+        burst = cols.burst[rep]
+        burst = np.where(burst == 0, lim, burst)
+        shift = np.uint64(44)  # _ARRIVAL_SHIFT; vectorized arrival_dev
+        sh = (
+            (uniq.view(np.uint64) >> shift) % np.uint64(n_shards)
+        ).astype(np.int32)
+        rnd, lane, n_rounds = native.assign_rounds(uniq, sh, n_shards, B)
+        values = dict(
+            key_hash=uniq, hits=hits_sum, limit=lim,
+            duration=cols.duration[rep], algo=cols.algo[rep],
+            burst=burst,
+            reset_remaining=(
+                cols.behavior[rep] & int(Behavior.RESET_REMAINING)
+            ) != 0,
+            is_greg=is_greg[rep], greg_expire=ge[rep],
+            greg_duration=gd[rep],
+            use_cached=np.ones(m, dtype=bool),
+        )
+        rounds, order, bounds = _build_rounds(
+            values, rnd, lane, sh, n_rounds, n_shards, B
+        )
+        # _decode_unique yields groups in ascending-hash order — exactly
+        # uniq's order — so the decoded reqs zip with the computed sums
+        # and arrival shards (one source of truth for both).
+        pend = [
+            (req, int(hits_sum[j]), int(sh[j]))
+            for j, (req, _group) in enumerate(
+                self._decode_unique(payload, cols, idx)
+            )
+        ]
+        resps, want_sync = engine.serve_packed(rounds, pend)
+        host = packed_grid_rounds_to_host(resps)
+
+        st_u = np.zeros(m, dtype=np.int64)
+        lm_u = np.zeros(m, dtype=np.int64)
+        rem_u = np.zeros(m, dtype=np.int64)
+        rst_u = np.zeros(m, dtype=np.int64)
+        for r_idx in range(n_rounds):
+            sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+            hr = host[r_idx]
+            at = (sh[sel], lane[sel])
+            st_u[sel] = hr["status"][at]
+            lm_u[sel] = hr["limit"][at]
+            rem_u[sel] = hr["remaining"][at]
+            rst_u[sel] = hr["reset_time"][at]
+
+        t = tally_from_rounds(rounds, host)
+        self.s.backend._add_tally(Tally(
+            checks=m,
+            over_limit=int((st_u == 1).sum()),
+            not_persisted=t.not_persisted,
+            cache_hits=t.cache_hits,
+        ))
+        if want_sync:
+            engine.sync()
+        return st_u[inv], lm_u[inv], rem_u[inv], rst_u[inv]
 
     @staticmethod
     def _sketch_meta(n: int, sk) -> Tuple[Optional[bytes],
@@ -398,14 +519,36 @@ class FastPath:
         np.cumsum([len(m) for m in metas], out=off[1:])
         return b"".join(metas), off
 
-    async def _serve(self, payload, cols, n: int, is_global, sk) -> bytes:
-        """Single-node / peer-RPC path: everything is local (and owned, so
-        GLOBAL lanes serve authoritatively and queue broadcast updates)."""
+    async def _serve(
+        self, payload, cols, n: int, is_global, sk, peer_rpc=False
+    ) -> bytes:
+        """Single-node / peer-RPC path: everything is local (and owned,
+        so GLOBAL lanes serve authoritatively and queue broadcast
+        updates).  On a mesh service the CLIENT path routes GLOBAL lanes
+        to the collective GlobalEngine; the peer RPC keeps RPC-tier
+        semantics (machinery serve + queued update) like the object
+        path's _check_local — engine keys sync over ICI, cross-node
+        forwards ride the managers."""
         is_greg, ge, gd, err_extra = self._prep_greg(cols, exclude=sk)
+        eng = None
+        if (
+            self.s.global_engine is not None
+            and not peer_rpc
+            and is_global.any()
+        ):
+            eng = is_global & (cols.err == 0)
+            if not eng.any():
+                eng = None
         status, limit, remaining, reset = await self._serve_split(
-            cols, is_greg, ge, gd, None, sk
+            payload, cols, is_greg, ge, gd, None, sk, eng
         )
-        if is_global.any():
+        if eng is not None:
+            # Metric parity: the object path's routing counts engine
+            # requests under the "global" source label.
+            self.s.metrics.getratelimit_counter.labels("global").inc(
+                int(eng.sum())
+            )
+        if is_global.any() and eng is None:
             self._queue_global(
                 payload, cols,
                 np.flatnonzero(is_global & (cols.err == 0)),
@@ -484,8 +627,18 @@ class FastPath:
             # propagate so the GLOBAL queue/metadata block (filtered on
             # cols.err == 0) never replicates or annotates a failed lane.
             cols.err[idx] = sub.err
+            sub_eng = None
+            if self.s.global_engine is not None:
+                # Node-owned GLOBAL lanes ride the collective engine
+                # (service.py routing: owner + engine -> engine_idx).
+                sub_eng = (
+                    is_global[idx] & owned[idx] & (sub.err == 0)
+                )
+                if not sub_eng.any():
+                    sub_eng = None
             st, lm, rem, rst = await self._serve_split(
-                sub, is_greg, ge, gd, glob_cached[idx], sub_sk
+                payload, sub, is_greg, ge, gd, glob_cached[idx], sub_sk,
+                sub_eng,
             )
             status[idx] = st
             out_lim[idx] = lm
@@ -498,10 +651,12 @@ class FastPath:
             if sub_sk is not None:
                 for i in idx[sub_sk]:
                     metas[int(i)] = _TIER_SKETCH_FRAME
-            # Metric parity: the object path labels owner-side GLOBAL
-            # "local" (service.py routing); only non-owned GLOBAL reads
-            # count as "global".
-            n_glob = int(glob_cached[idx].sum())
+            # Metric parity with the object path's routing: non-owned
+            # GLOBAL reads and engine-served lanes count as "global",
+            # everything else owner-side counts as "local".
+            n_glob = int(glob_cached[idx].sum()) + (
+                int(sub_eng.sum()) if sub_eng is not None else 0
+            )
             m = self.s.metrics.getratelimit_counter
             if n_glob:
                 m.labels("global").inc(n_glob)
@@ -628,11 +783,15 @@ class FastPath:
                     peers[int(owner[int(i)])].info().grpc_address.encode()
                 )
             self._queue_global(payload, cols, gc_idx, as_update=False)
-            self._queue_global(
-                payload, cols,
-                np.flatnonzero(is_global & owned & (cols.err == 0)),
-                as_update=True,
-            )
+            if self.s.global_engine is None:
+                # Owner-side updates broadcast via the RPC manager only
+                # when no collective engine owns replication (the engine
+                # broadcasts through sync + the _engine_synced bridge).
+                self._queue_global(
+                    payload, cols,
+                    np.flatnonzero(is_global & owned & (cols.err == 0)),
+                    as_update=True,
+                )
 
         mr = (cols.behavior & _MULTI_REGION) != 0
         if mr.any():

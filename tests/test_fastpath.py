@@ -714,3 +714,171 @@ def test_multiregion_forwarded_queues_at_owner():
         cl.close()
     finally:
         c.stop()
+
+
+# -- mesh GLOBAL (collective engine) on the compiled lane ------------------
+
+def _stop_collective_loop(c, daemon_idx=0):
+    """Cancel a daemon's background sync loop (no final flush) so tests
+    drive engine.sync() deterministically — serving opens sync windows
+    (notify), and a mid-test background flush would race assertions on
+    pending/remaining."""
+    async def stop():
+        lp = c.daemons[daemon_idx].service._collective_loop
+        if lp is not None and lp._task is not None:
+            lp._task.cancel()
+            await asyncio.gather(lp._task, return_exceptions=True)
+            lp._task = None
+
+    c.run(stop(), timeout=30)
+
+
+def test_mesh_global_engine_rides_fast_lane():
+    """Node-owned GLOBAL lanes on a mesh daemon serve through the
+    collective GlobalEngine ON the compiled lane: replicated-cache
+    serving with duplicate lanes sharing one aggregated response
+    (engine semantics), pending hits queued for the next collective
+    sync, and sync applying them to the auth table."""
+    c = Cluster.start(
+        1,
+        device=DeviceConfig(
+            num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+        ),
+    )
+    try:
+        _stop_collective_loop(c)
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        svc = c.daemons[0].service
+        eng = svc.global_engine
+        assert eng is not None
+        before, fb = fp.served, fp.fallbacks
+        r = cl.get_rate_limits([
+            RateLimitReq(name="eng", unique_key="a", hits=1, limit=10,
+                         duration=60_000, behavior=Behavior.GLOBAL),
+            RateLimitReq(name="eng", unique_key="a", hits=3, limit=10,
+                         duration=60_000, behavior=Behavior.GLOBAL),
+            RateLimitReq(name="plain", unique_key="p", hits=1, limit=10,
+                         duration=60_000),
+        ])
+        assert fp.served == before + 3
+        assert fp.fallbacks == fb  # no object-path fallback
+        assert [x.error for x in r] == ["", "", ""]
+        # Engine dedup: duplicates share ONE aggregated response
+        # (hits summed to 4), unlike the machinery's sequential cascade.
+        assert r[0].remaining == 6
+        assert r[1].remaining == 6
+        assert r[2].remaining == 9
+        # The hit queued for the collective sync with summed hits...
+        assert eng.pending["eng_a"].hits == 4
+        # ...served from the replicated cache, not the auth table yet.
+        assert eng.get_cached("eng_a") is not None
+        # Sync applies the pending hits to the auth table.
+        eng.sync()
+        assert eng.pending == {}
+        assert svc.backend.checks >= 1
+        # A later serve is a stale-but-fast CACHED read (no local
+        # decrement — getGlobalRateLimit semantics); its hit queues.
+        r2 = cl.get_rate_limits([
+            RateLimitReq(name="eng", unique_key="a", hits=1, limit=10,
+                         duration=60_000, behavior=Behavior.GLOBAL),
+        ])
+        assert r2[0].remaining == 6
+        assert eng.pending["eng_a"].hits == 1
+        # The next sync folds that hit into the authoritative bucket and
+        # broadcasts it back to the replicated cache.
+        eng.sync()
+        r3 = cl.get_rate_limits([
+            RateLimitReq(name="eng", unique_key="a", hits=1, limit=10,
+                         duration=60_000, behavior=Behavior.GLOBAL),
+        ])
+        assert r3[0].remaining == 5
+        cl.close()
+    finally:
+        c.stop()
+
+
+def test_mesh_global_engine_wire_matches_object_path():
+    """Differential through the WIRE: a mesh daemon's fast-lane GLOBAL
+    responses must equal the object path's for the same stream (the
+    object path forced by detaching the daemon's fastpath)."""
+    import numpy as np
+
+    dev = DeviceConfig(
+        num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+    )
+    rng = np.random.default_rng(11)
+
+    def stream():
+        out = []
+        for step in range(6):
+            ks = rng.integers(0, 12, size=24)
+            out.append([
+                RateLimitReq(
+                    name="dg", unique_key=f"k{k}", hits=1, limit=50,
+                    duration=60_000, behavior=Behavior.GLOBAL,
+                )
+                for k in ks
+            ])
+        return out
+
+    rng = np.random.default_rng(11)
+    batches_a = stream()
+    rng = np.random.default_rng(11)
+    batches_b = stream()
+
+    got = {}
+    for label, batches, disable_fp in (
+        ("fast", batches_a, False), ("object", batches_b, True)
+    ):
+        c = Cluster.start(1, device=dev)
+        try:
+            # Both runs must sync at the same (never) points — an
+            # uncorrelated background flush mid-stream would change
+            # `remaining` in one run only.
+            _stop_collective_loop(c)
+            if disable_fp:
+                c.daemons[0].fastpath = None
+            cl = V1Client(c.addresses()[0])
+            resps = []
+            for b in batches:
+                resps.append([
+                    (x.status, x.limit, x.remaining) for x in
+                    cl.get_rate_limits(b)
+                ])
+            got[label] = resps
+            cl.close()
+        finally:
+            c.stop()
+    assert got["fast"] == got["object"]
+
+
+def test_mesh_global_engine_background_sync_fires():
+    """A single fast-lane GLOBAL hit must open the collective sync
+    window (notify) — low-traffic nodes converge on the sync cadence,
+    not only at the batch limit."""
+    import time
+
+    c = Cluster.start(
+        1,
+        device=DeviceConfig(
+            num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+        ),
+    )
+    try:
+        cl = V1Client(c.addresses()[0])
+        svc = c.daemons[0].service
+        r = cl.get_rate_limits([
+            RateLimitReq(name="bg", unique_key="one", hits=2, limit=10,
+                         duration=60_000, behavior=Behavior.GLOBAL),
+        ])
+        assert r[0].error == ""
+        assert _fp(c).fallbacks == 0
+        deadline = time.monotonic() + 10.0
+        while svc.global_engine.pending:
+            assert time.monotonic() < deadline, "sync window never fired"
+            time.sleep(0.05)
+        assert svc.backend.checks >= 1  # auth table received the hit
+        cl.close()
+    finally:
+        c.stop()
