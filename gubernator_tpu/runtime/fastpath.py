@@ -283,10 +283,15 @@ class FastPath:
         await self._queue.put(entry)
         return await entry.fut
 
-    def _decode_unique(self, payload, cols, idx):
+    def _decode_unique(self, payload, cols, idx, last=False):
         """Yield (req, group_indices) for each UNIQUE key hash among the
         request indices `idx` — one protobuf decode per unique key (the
-        managers aggregate by key anyway, global.go:87-95)."""
+        managers aggregate by key anyway, global.go:87-95).  `last`
+        decodes the group's LAST arrival instead of its first: the
+        update queue is last-write-wins per key (queue_update), and the
+        broadcast's zero-hit re-read uses the queued request's params —
+        first-occurrence params would recreate the bucket differently
+        on an algorithm/burst change within one batch."""
         from gubernator_tpu.net.grpc_api import req_from_pb
         from gubernator_tpu.proto import gubernator_pb2 as pb
 
@@ -300,7 +305,7 @@ class FastPath:
         for b_i, lo in enumerate(bounds):
             hi = bounds[b_i + 1] if b_i + 1 < len(bounds) else len(order)
             group = order[lo:hi]
-            fi = int(group[0])
+            fi = int(group[-1] if last else group[0])
             frame = payload[
                 cols.msg_off[fi]:cols.msg_off[fi] + cols.msg_len[fi]
             ]
@@ -316,7 +321,9 @@ class FastPath:
         if not len(idx):
             return
         mgr = self.s.global_mgr
-        for req, group in self._decode_unique(payload, cols, idx):
+        for req, group in self._decode_unique(
+            payload, cols, idx, last=as_update
+        ):
             if as_update:
                 mgr.queue_update(req)
             else:

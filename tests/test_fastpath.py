@@ -882,3 +882,125 @@ def test_mesh_global_engine_background_sync_fires():
         cl.close()
     finally:
         c.stop()
+
+
+def test_fastpath_differential_mixed_behaviors(frozen_clock):
+    """Randomized wire-level differential across the WHOLE behavior
+    surface the fast lane serves: exact token/leaky, GLOBAL,
+    MULTI_REGION, RESET_REMAINING, Gregorian (valid and invalid),
+    sketch-named lanes (including GLOBAL+sketch stripping), validation
+    errors, hot duplicates, and zero/negative hits — responses
+    (including metadata) must be identical to the object path under a
+    frozen clock."""
+    import asyncio
+    import random
+
+    from gubernator_tpu.core.config import Config, SketchTierConfig
+    from gubernator_tpu.net.grpc_api import reqs_from_pb
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    async def scenario():
+        dev = DeviceConfig(num_slots=4096, ways=8, batch_size=64)
+        sketch = SketchTierConfig(
+            names=["sk"], width=2048, window_ms=3_600_000, batch_size=64
+        )
+        s_fast = Service(Config(device=dev, sketch=sketch),
+                         clock=frozen_clock)
+        s_ref = Service(Config(device=dev, sketch=sketch),
+                        clock=frozen_clock)
+        await s_fast.start()
+        await s_ref.start()
+        # The GLOBAL broadcast's zero-hit re-read mutates on algorithm/
+        # params switches, so background flushes at uncorrelated stream
+        # positions would diverge the two services' states even with
+        # identical queues.  Cancel the loops and flush BOTH services at
+        # the same point each step — which also differentially tests the
+        # queued update content itself.
+        for svc in (s_fast, s_ref):
+            for t in svc.global_mgr._tasks:
+                t.cancel()
+            await asyncio.gather(
+                *svc.global_mgr._tasks, return_exceptions=True
+            )
+            svc.global_mgr._tasks = []
+
+        async def flush_globals() -> None:
+            for svc in (s_fast, s_ref):
+                upd = svc.global_mgr._take_updates()
+                if upd:
+                    await svc.global_mgr._broadcast_peers(upd)
+                hits = svc.global_mgr._take_hits()
+                if hits:
+                    await svc.global_mgr._send_hits(hits)
+
+        fp = FastPath(s_fast)
+        rng = random.Random(31)
+        for step in range(25):
+            n = rng.randint(1, 60)
+            reqs = []
+            for _ in range(n):
+                behavior = 0
+                if rng.random() < 0.08:
+                    behavior |= 8   # RESET_REMAINING
+                if rng.random() < 0.15:
+                    behavior |= 2   # GLOBAL
+                if rng.random() < 0.15:
+                    behavior |= 16  # MULTI_REGION
+                name = rng.choice(["ex", "ex", "ex", "sk", "sk"])
+                duration = 60_000
+                if name == "ex" and rng.random() < 0.08:
+                    behavior |= 4   # DURATION_IS_GREGORIAN
+                    duration = rng.choice([1, 4, 99])  # 99 = invalid
+                key = f"d{rng.randint(0, 7)}"
+                if rng.random() < 0.03:
+                    key = ""        # validation error
+                reqs.append(pb.RateLimitReq(
+                    name=name,
+                    unique_key=key,
+                    hits=rng.choice([0, 1, 1, 1, 2, 3, -1]),
+                    limit=rng.choice([20, 20, 20, 30]),
+                    duration=duration,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 25]),
+                ))
+            payload = pb.GetRateLimitsReq(
+                requests=reqs
+            ).SerializeToString()
+            out = await fp.check_raw(payload, peer_rpc=False)
+            assert out is not None
+            got = pb.GetRateLimitsResp.FromString(out).responses
+            want = await s_ref.get_rate_limits(reqs_from_pb(reqs))
+            assert len(got) == len(reqs)
+            for j, (g, w) in enumerate(zip(got, want)):
+                assert g.error == w.error, (step, j)
+                assert g.status == int(w.status), (step, j)
+                assert g.limit == w.limit, (step, j)
+                assert g.remaining == w.remaining, (step, j)
+                assert g.reset_time == w.reset_time, (step, j)
+                assert dict(g.metadata) == dict(w.metadata), (step, j)
+            await flush_globals()
+            # Responses alone can mask divergence (a later occurrence's
+            # response may be computed before an earlier lane's write
+            # semantics differ) — the STORED rows must match too.
+            for k in [f"ex_d{i}" for i in range(8)]:
+                a = s_fast.backend.get_cache_item(k)
+                b = s_ref.backend.get_cache_item(k)
+                ta = (
+                    (a.remaining, a.expire_at, int(a.status), a.limit)
+                    if a else None
+                )
+                tb = (
+                    (b.remaining, b.expire_at, int(b.status), b.limit)
+                    if b else None
+                )
+                assert ta == tb, (step, k)
+            frozen_clock.advance(rng.choice([0, 100, 5_000]))
+        assert fp.served > 0
+        await fp.close()
+        await s_fast.close()
+        await s_ref.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
