@@ -4,6 +4,7 @@ Scenarios, each against an in-process daemon pair over real gRPC:
   peer_rpc       — direct GetPeerRateLimits, NO_BATCHING analog
   get_ratelimits — client GetRateLimits, owner-local keys
   global         — GLOBAL behavior reads on a non-owner
+  sketch         — approximate-tier (CMS) checks on a sketch-named limit
   healthcheck    — HealthCheck RPC
   herd           — 100-way concurrent fan-out on one key (thundering herd)
 
@@ -33,6 +34,7 @@ from gubernator_tpu.client import AsyncV1Client
 from gubernator_tpu.core.config import (
     DaemonConfig,
     DeviceConfig,
+    SketchTierConfig,
     fast_test_behaviors,
 )
 from gubernator_tpu.core.types import Behavior, PeerInfo, RateLimitReq
@@ -72,6 +74,10 @@ async def run(args) -> None:
                 device=DeviceConfig(
                     num_slots=args.slots, batch_size=args.batch
                 ),
+                sketch=SketchTierConfig(
+                    names=["bench_sketch"], width=1 << 16,
+                    window_ms=60_000, batch_size=args.batch,
+                ),
             )
         )
         await d.start()
@@ -89,17 +95,21 @@ async def run(args) -> None:
     peers_stub = PeersV1Stub(ch)
 
     # A key owned by daemon 0 (so "local") and one owned by daemon 1.
-    def owned_by(d):
+    # Ownership depends on the FULL hash key, so each scenario's name
+    # needs its own lookup (a key local under "bench" may be remote
+    # under "bench_sketch").
+    def owned_by(d, name):
         i = 0
         while True:
             key = f"bench_k{i}"
-            peer = daemons[0].service.get_peer(f"bench_{key}")
+            peer = daemons[0].service.get_peer(f"{name}_{key}")
             if peer.info().grpc_address == d.grpc_address:
                 return key
             i += 1
 
-    local_key = owned_by(daemons[0])
-    remote_key = owned_by(daemons[1])
+    local_key = owned_by(daemons[0], "bench")
+    remote_key = owned_by(daemons[1], "bench")
+    sketch_key = owned_by(daemons[0], "bench_sketch")
 
     async def peer_rpc():
         await peers_stub.GetPeerRateLimits(
@@ -124,6 +134,12 @@ async def run(args) -> None:
                          behavior=Behavior.GLOBAL)
         ])
 
+    async def sketch():
+        await client.get_rate_limits([
+            RateLimitReq(name="bench_sketch", unique_key=sketch_key, hits=1,
+                         limit=1_000_000_000, duration=60_000)
+        ])
+
     async def healthcheck():
         await client.health_check()
 
@@ -140,6 +156,7 @@ async def run(args) -> None:
         "peer_rpc": (peer_rpc, args.concurrency),
         "get_ratelimits": (get_ratelimits, args.concurrency),
         "global": (global_read, args.concurrency),
+        "sketch": (sketch, args.concurrency),
         "healthcheck": (healthcheck, args.concurrency),
         "herd_100way": (herd, 1),
     }

@@ -193,6 +193,10 @@ class Service:
             await loop.run_in_executor(
                 self._dev_executor, self.global_engine.warmup
             )
+        if self.sketch_backend is not None:
+            await loop.run_in_executor(
+                self._dev_executor, self.sketch_backend.warmup
+            )
 
     # ------------------------------------------------------------------
     # peer management

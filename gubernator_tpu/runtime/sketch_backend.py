@@ -73,6 +73,12 @@ class SketchBackend:
     def handles(self, req: RateLimitReq) -> bool:
         return req.name in self.cfg.names
 
+    def warmup(self) -> None:
+        """Compile the single-chunk merge step (service warmup, like the
+        sibling backends); larger chunk counts compile lazily outside the
+        dispatch lock."""
+        self._multi_step(1)
+
     def _advance_window(self, now_ms: int) -> None:
         """The kernel's rotation arithmetic on the host mirror (called
         under the lock, with the same `now` the dispatch uses)."""
