@@ -1004,3 +1004,64 @@ def test_fastpath_differential_mixed_behaviors(frozen_clock):
         await s_ref.close()
 
     asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_mesh_global_engine_routed_multinode():
+    """Two mesh daemons: node-OWNED GLOBAL lanes ride the collective
+    engine on the routed fast lane, non-owned GLOBAL lanes serve as
+    cached reads with hits queued toward the owning node — and no
+    owner-side RPC update broadcast is queued (the engine's sync bridge
+    owns replication)."""
+    dev = DeviceConfig(
+        num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+    )
+    c = Cluster.start(2, device=dev)
+    try:
+        _stop_collective_loop(c, 0)
+        _stop_collective_loop(c, 1)
+
+        # Also cancel node 0's RPC-tier manager loops: the 50ms hits
+        # flush would drain global_mgr._hits mid-assertion.
+        async def stop_mgr():
+            mgr = c.daemons[0].service.global_mgr
+            for t in mgr._tasks:
+                t.cancel()
+            await asyncio.gather(*mgr._tasks, return_exceptions=True)
+            mgr._tasks = []
+
+        c.run(stop_mgr(), timeout=30)
+        cl = V1Client(c.addresses()[0])
+        fp = _fp(c)
+        svc0 = c.daemons[0].service
+        me = c.daemons[0].advertise_address()
+        keys = [f"rte{i}" for i in range(40)]
+        owned = [
+            k for k in keys
+            if svc0.get_peer(f"g_{k}").info().grpc_address == me
+        ]
+        remote = [k for k in keys if k not in owned]
+        assert owned and remote
+        rs = cl.get_rate_limits([
+            RateLimitReq(name="g", unique_key=k, hits=1, limit=50,
+                         duration=60_000, behavior=Behavior.GLOBAL)
+            for k in keys
+        ])
+        by_key = dict(zip(keys, rs))
+        assert all(x.error == "" for x in rs)
+        assert fp.served == len(keys) and fp.fallbacks == 0
+        # Owned keys: engine pending on node 0, no owner metadata, and
+        # crucially NO RPC-tier update broadcast queued.
+        for k in owned:
+            assert f"g_{k}" in svc0.global_engine.pending, k
+            assert "owner" not in by_key[k].metadata, k
+        assert svc0.global_mgr._updates == {}
+        # Non-owned keys: cached read annotated with the owning node,
+        # hit queued toward it via the RPC tier.
+        other = c.daemons[1].advertise_address()
+        for k in remote:
+            assert by_key[k].metadata.get("owner") == other, k
+            assert f"g_{k}" in svc0.global_mgr._hits, k
+            assert f"g_{k}" not in svc0.global_engine.pending, k
+        cl.close()
+    finally:
+        c.stop()
