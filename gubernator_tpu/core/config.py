@@ -247,6 +247,27 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     static_peers = [
         p.strip() for p in _env("GUBER_PEERS").split(",") if p.strip()
     ]
+    sketch: Optional[SketchTierConfig] = None
+    sketch_names = [
+        n.strip() for n in _env("GUBER_SKETCH_NAMES").split(",") if n.strip()
+    ]
+    if sketch_names:
+        window_ms = int(_env_float_s("GUBER_SKETCH_WINDOW", 1.0) * 1000)
+        if window_ms < 1:
+            # Fail at parse: a zero/negative window reaches the rotation
+            # arithmetic as a modulo-by-zero and serves garbage silently.
+            raise ValueError(
+                "GUBER_SKETCH_WINDOW must be >= 1ms, got "
+                f"{_env('GUBER_SKETCH_WINDOW')!r}"
+            )
+        sketch = SketchTierConfig(
+            names=sketch_names,
+            depth=_env_int("GUBER_SKETCH_DEPTH", 4),
+            width=_env_int("GUBER_SKETCH_WIDTH", 8192),
+            window_ms=window_ms,
+            batch_size=_env_int("GUBER_SKETCH_BATCH_SIZE", 1024),
+            use_pallas=_env("GUBER_SKETCH_USE_PALLAS") == "true",
+        )
     return DaemonConfig(
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "localhost:1051"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "localhost:1050"),
@@ -270,6 +291,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         etcd_endpoints=_env("GUBER_ETCD_ENDPOINTS", "localhost:2379"),
         log_level=_env("GUBER_LOG_LEVEL", "info"),
         tls=tls,
+        sketch=sketch,
         # Bit 1 = process/platform/GC collectors (the GUBER_METRIC_FLAGS
         # golang/process flags, daemon.go:255-266, flags.go:19-56).
         metric_flags=_env_int("GUBER_METRIC_FLAGS", 0),

@@ -143,3 +143,37 @@ def test_hashing():
     assert key_hash64("foo_bar") != 0
     hs = bulk_key_hash64(["a_1", "a_2", "a_1"])
     assert hs[0] == hs[2] != hs[1]
+
+
+def test_sketch_tier_env_config(monkeypatch):
+    """GUBER_SKETCH_* env vars build the approximate tier
+    (setup_daemon_config) — deployments aren't limited to programmatic
+    config."""
+    from gubernator_tpu.core.config import setup_daemon_config
+
+    for v in ("NAMES", "DEPTH", "WIDTH", "WINDOW", "BATCH_SIZE",
+              "USE_PALLAS"):
+        monkeypatch.delenv(f"GUBER_SKETCH_{v}", raising=False)
+    monkeypatch.setenv("GUBER_SKETCH_NAMES", "per_ip, abuse")
+    monkeypatch.setenv("GUBER_SKETCH_WIDTH", "65536")
+    monkeypatch.setenv("GUBER_SKETCH_WINDOW", "30s")
+    conf = setup_daemon_config()
+    assert conf.sketch is not None
+    assert conf.sketch.names == ["per_ip", "abuse"]
+    assert conf.sketch.width == 65536
+    assert conf.sketch.window_ms == 30_000
+    assert conf.sketch.depth == 4
+
+    monkeypatch.delenv("GUBER_SKETCH_NAMES")
+    assert setup_daemon_config().sketch is None
+
+
+def test_sketch_tier_env_rejects_zero_window(monkeypatch):
+    import pytest as _pytest
+
+    from gubernator_tpu.core.config import setup_daemon_config
+
+    monkeypatch.setenv("GUBER_SKETCH_NAMES", "per_ip")
+    monkeypatch.setenv("GUBER_SKETCH_WINDOW", "500us")
+    with _pytest.raises(ValueError, match="GUBER_SKETCH_WINDOW"):
+        setup_daemon_config()
