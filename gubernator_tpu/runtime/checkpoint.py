@@ -70,9 +70,11 @@ class TableCheckpointer:
         backend,  # DeviceBackend or MeshBackend
         step: int,
         keep: int = 3,
+        sketch=None,  # SketchBackend — include the CMS state
     ) -> str:
-        """Checkpoint the table (and keymap when tracked); prunes old
-        steps beyond `keep`."""
+        """Checkpoint the table (and keymap when tracked; and the sketch
+        tier's CMS state when passed — long-window abuse counters should
+        survive a restart); prunes old steps beyond `keep`."""
         # Copy to host while holding the lock: the step functions donate the
         # table buffers, so a concurrent check() would delete the captured
         # device arrays mid-serialization ("Array has been deleted").
@@ -81,6 +83,15 @@ class TableCheckpointer:
             keymap = (
                 dict(backend._keymap) if backend._keymap is not None else None
             )
+        if sketch is not None:
+            with sketch._lock:
+                st = sketch.state
+                payload["sketch"] = {
+                    "cur": np.asarray(st.cur),
+                    "prev": np.asarray(st.prev),
+                    "window_start": np.asarray(st.window_start),
+                    "window_ms": np.asarray(st.window_ms),
+                }
         path = self._step_dir(step)
         self._ckptr.save(path, payload, force=True)
         if keymap is not None:
@@ -90,11 +101,16 @@ class TableCheckpointer:
         log.info("checkpointed table to %s", path)
         return path
 
-    def restore(self, backend, step: Optional[int] = None) -> int:
+    def restore(self, backend, step: Optional[int] = None,
+                sketch=None) -> int:
         """Restore the table in place; returns the restored step.  Works
         for DeviceBackend and MeshBackend alike — `_install_table` handles
         placement (sharded over the mesh for the latter; orbax stores the
-        host copy either way)."""
+        host copy either way).  With `sketch`, restores the CMS state too
+        (a checkpoint without sketch state leaves the live sketch
+        untouched); the host window mirror follows the restored
+        window_start, and the next check's rotation handles any elapsed
+        downtime exactly like elapsed uptime."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -107,6 +123,41 @@ class TableCheckpointer:
             f: np.asarray(v) for f, v in payload["table"].items()
         }
         backend._install_table(arrays)
+        if sketch is not None and "sketch" in payload:
+            import jax.numpy as jnp
+
+            from gubernator_tpu.ops.sketch import SketchState
+
+            sk = payload["sketch"]
+            cur = np.asarray(sk["cur"])
+            if cur.shape != (sketch.cfg.depth, sketch.cfg.width):
+                # A resized sketch hashes keys to different cells — old
+                # counts are meaningless under the new geometry.  Start
+                # fresh rather than installing garbage.
+                log.warning(
+                    "checkpointed sketch geometry %s != configured "
+                    "(%d, %d); skipping sketch restore",
+                    cur.shape, sketch.cfg.depth, sketch.cfg.width,
+                )
+            else:
+                # The CURRENT config owns window_ms (the host mirror and
+                # reset_time already use it); installing the checkpoint's
+                # value would desync device rotation from the host
+                # mirror after a window reconfiguration.
+                with sketch._lock:
+                    sketch.state = SketchState(
+                        cur=jnp.asarray(cur),
+                        prev=jnp.asarray(np.asarray(sk["prev"])),
+                        window_start=jnp.asarray(
+                            np.asarray(sk["window_start"])
+                        ),
+                        window_ms=jnp.asarray(
+                            np.int64(sketch.cfg.window_ms)
+                        ),
+                    )
+                    sketch._win_start = int(
+                        np.asarray(sk["window_start"])
+                    )
         km_path = os.path.join(path, "keymap.json")
         if os.path.exists(km_path) and backend._keymap is not None:
             with open(km_path) as f:
@@ -134,9 +185,11 @@ class PeriodicCheckpointLoop:
         directory: str,
         interval_s: float = 30.0,
         keep: int = 3,
+        sketch=None,  # SketchBackend — snapshot the CMS state too
     ) -> None:
         self.ckptr = TableCheckpointer(directory)
         self.backend = backend
+        self.sketch = sketch
         self.interval_s = interval_s
         self.keep = keep
         self._task: Optional[asyncio.Task] = None
@@ -165,7 +218,10 @@ class PeriodicCheckpointLoop:
         self._step += 1
         try:
             await loop.run_in_executor(
-                None, lambda: self.ckptr.save(self.backend, step, self.keep)
+                None,
+                lambda: self.ckptr.save(
+                    self.backend, step, self.keep, sketch=self.sketch
+                ),
             )
         except Exception as e:  # noqa: BLE001
             log.error("periodic checkpoint failed: %s", e)
@@ -182,11 +238,13 @@ class OrbaxLoader(Loader):
     def __init__(self, directory: str) -> None:
         self.ckptr = TableCheckpointer(directory)
         self._backend: Optional[DeviceBackend] = None
+        self._sketch = None
 
-    def attach(self, backend: DeviceBackend) -> None:
+    def attach(self, backend: DeviceBackend, sketch=None) -> None:
         self._backend = backend
+        self._sketch = sketch
         try:
-            self.ckptr.restore(backend)
+            self.ckptr.restore(backend, sketch=sketch)
         except FileNotFoundError:
             pass
 
@@ -196,4 +254,4 @@ class OrbaxLoader(Loader):
     def save(self, items: Iterator[CacheItem]) -> None:
         if self._backend is not None:
             step = (self.ckptr.latest_step() or 0) + 1
-            self.ckptr.save(self._backend, step)
+            self.ckptr.save(self._backend, step, sketch=self._sketch)

@@ -50,3 +50,87 @@ def test_latest_and_prune(tmp_path):
         if d.name.startswith("step_")
     )
     assert steps == [4, 5]
+
+
+def test_sketch_state_checkpoint_roundtrip(tmp_path):
+    """The CMS state checkpoints beside the slot table: a restart with a
+    long window must not forget abuse counters."""
+    import numpy as np
+
+    from gubernator_tpu.core.config import SketchTierConfig
+    from gubernator_tpu.runtime.sketch_backend import SketchBackend
+
+    dev = DeviceConfig(num_slots=1024, ways=8, batch_size=64)
+    be = DeviceBackend(dev)
+    sk = SketchBackend(SketchTierConfig(
+        names=["per_ip"], width=2048, window_ms=3_600_000, batch_size=64
+    ))
+    kh = np.arange(1, 11, dtype=np.int64) * 7919
+    hits = np.full(10, 3, dtype=np.int64)
+    lims = np.full(10, 10, dtype=np.int64)
+    sk.check_cols(kh, hits, lims)
+    st1, rem1, _ = sk.check_cols(kh, hits, lims)  # estimates include 3
+
+    ck = TableCheckpointer(str(tmp_path))
+    ck.save(be, step=1, sketch=sk)
+
+    # Fresh process analog: new backend + sketch, restore both.
+    be2 = DeviceBackend(dev)
+    sk2 = SketchBackend(SketchTierConfig(
+        names=["per_ip"], width=2048, window_ms=3_600_000, batch_size=64
+    ))
+    ck.restore(be2, sketch=sk2)
+    assert sk2._win_start == sk._win_start
+    st2, rem2, _ = sk2.check_cols(kh, hits, lims)
+    # The restored sketch continues the restored counts: identical
+    # decisions/remaining to a non-restarted sketch at the same point.
+    st_ref, rem_ref, _ = sk.check_cols(kh, hits, lims)
+    assert list(st2) == list(st_ref)
+    assert list(rem2) == list(rem_ref)
+    # And the counts actually carried over (remaining dropped below the
+    # fresh-sketch value).
+    assert all(r2 < r1 for r2, r1 in zip(rem2, rem1))
+
+    # A checkpoint WITHOUT sketch state leaves the live sketch untouched.
+    ck.save(be, step=2)
+    before = np.asarray(sk2.state.cur).copy()
+    ck.restore(be2, step=2, sketch=sk2)
+    assert np.array_equal(np.asarray(sk2.state.cur), before)
+
+
+def test_orbax_loader_carries_sketch(tmp_path):
+    """The Loader-SPI adapter persists and restores the sketch when one
+    is attached (the production wiring path)."""
+    import numpy as np
+
+    from gubernator_tpu.core.config import SketchTierConfig
+    from gubernator_tpu.runtime.checkpoint import OrbaxLoader
+    from gubernator_tpu.runtime.sketch_backend import SketchBackend
+
+    dev = DeviceConfig(num_slots=1024, ways=8, batch_size=64)
+    cfg = SketchTierConfig(
+        names=["per_ip"], width=2048, window_ms=3_600_000, batch_size=64
+    )
+    be, sk = DeviceBackend(dev), SketchBackend(cfg)
+    kh = np.array([111, 222], dtype=np.int64)
+    sk.check_cols(kh, np.array([5, 2], dtype=np.int64),
+                  np.array([10, 10], dtype=np.int64))
+
+    ld = OrbaxLoader(str(tmp_path))
+    ld.attach(be, sketch=sk)
+    ld.save(iter([]))
+
+    be2, sk2 = DeviceBackend(dev), SketchBackend(cfg)
+    ld2 = OrbaxLoader(str(tmp_path))
+    ld2.attach(be2, sketch=sk2)
+    assert np.array_equal(np.asarray(sk2.state.cur), np.asarray(sk.state.cur))
+
+    # Geometry change: restore skips the sketch instead of installing
+    # garbage, and keeps the configured window authoritative.
+    sk3 = SketchBackend(SketchTierConfig(
+        names=["per_ip"], width=4096, window_ms=60_000, batch_size=64
+    ))
+    ld3 = OrbaxLoader(str(tmp_path))
+    ld3.attach(DeviceBackend(dev), sketch=sk3)
+    assert int(np.asarray(sk3.state.cur).sum()) == 0
+    assert int(np.asarray(sk3.state.window_ms)) == 60_000
