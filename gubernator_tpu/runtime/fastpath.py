@@ -228,6 +228,10 @@ class FastPath:
         is_greg = (
             cols.behavior & int(Behavior.DURATION_IS_GREGORIAN)
         ) != 0
+        # Validation errors take precedence: the object path's packer
+        # rejects an empty name/key BEFORE evaluating the Gregorian
+        # duration, so an already-errored lane must keep its error.
+        is_greg &= cols.err == 0
         if exclude is not None:
             is_greg &= ~exclude
         err_extra: Dict[int, bytes] = {}
@@ -312,23 +316,77 @@ class FastPath:
             m = pb.GetRateLimitsReq.FromString(frame).requests[0]
             yield req_from_pb(m), group
 
-    def _queue_global(self, payload, cols, idx, as_update: bool) -> None:
-        """Queue GLOBAL hits (non-owner) or broadcast updates (owner) for
-        the request indices `idx` — the deferred QueueHit/QueueUpdate of
-        gubernator.go:429-432/617."""
+    def _queue_global(self, payload, cols, idx) -> None:
+        """Queue GLOBAL hits (non-owner) for the request indices `idx` —
+        the deferred QueueHit of gubernator.go:429-432.  Errored lanes
+        are pre-filtered by the caller: a queued errored hit is dropped
+        by the owner's validation with no state effect anywhere, so the
+        bookkeeping difference from the object path (which queues before
+        validating) is unobservable."""
         from dataclasses import replace as dc_replace
 
         if not len(idx):
             return
         mgr = self.s.global_mgr
+        for req, group in self._decode_unique(payload, cols, idx):
+            total = int(cols.hits[group].sum())
+            mgr.queue_hit(dc_replace(req, hits=total))
+
+    def _queue_global_updates(self, payload, cols, is_global,
+                              owned=None) -> None:
+        """Queue owner-side broadcast updates for GLOBAL lanes — ERRORED
+        lanes included: the reference QueueUpdates before the algorithm
+        runs (gubernator.go:617-619), so with last-write-wins per key an
+        errored occurrence can cancel a valid one's pending broadcast.
+        The fast lane reproduces that exactly: the LAST arrival per key
+        wins, valid or not.
+
+        `owned` (routed path) masks node-owned lanes; errored lanes have
+        their device hash zeroed, so their ownership is decided from the
+        decoded key string like the object path's routing does."""
+        idx = np.flatnonzero(is_global)
+        if not len(idx):
+            return
+        hv = cols.hash[idx]
+        valid = idx[hv != 0]
+        if owned is not None:
+            valid = valid[owned[valid]]
+        best: Dict[str, Tuple[int, object]] = {}
         for req, group in self._decode_unique(
-            payload, cols, idx, last=as_update
+            payload, cols, valid, last=True
         ):
-            if as_update:
-                mgr.queue_update(req)
-            else:
-                total = int(cols.hits[group].sum())
-                mgr.queue_hit(dc_replace(req, hits=total))
+            best[req.hash_key()] = (int(group[-1]), req)
+        err_lanes = idx[hv == 0]
+        if len(err_lanes):
+            from gubernator_tpu.net.grpc_api import req_from_pb
+            from gubernator_tpu.proto import gubernator_pb2 as pb
+
+            sk_be = self.s.sketch_backend
+            for i in err_lanes:
+                i = int(i)
+                frame = payload[
+                    cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]
+                ]
+                m = pb.GetRateLimitsReq.FromString(frame).requests[0]
+                req = req_from_pb(m)
+                if sk_be is not None and sk_be.handles(req):
+                    # The object path strips GLOBAL from sketch names
+                    # unconditionally (errored or not) — a sketch key
+                    # never queues an exact-table broadcast.
+                    continue
+                key = req.hash_key()
+                if owned is not None:
+                    try:
+                        if not self.s.get_peer(key).info().is_owner:
+                            continue
+                    except Exception:  # noqa: BLE001 — PoolEmptyError
+                        continue
+                cur = best.get(key)
+                if cur is None or i > cur[0]:
+                    best[key] = (i, req)
+        mgr = self.s.global_mgr
+        for _, req in best.values():
+            mgr.queue_update(req)
 
     def _queue_multiregion(self, payload, cols, idx) -> None:
         """Queue owner-side MULTI_REGION hits for the request indices
@@ -537,12 +595,9 @@ class FastPath:
         path's _check_local — engine keys sync over ICI, cross-node
         forwards ride the managers."""
         is_greg, ge, gd, err_extra = self._prep_greg(cols, exclude=sk)
+        use_engine = self.s.global_engine is not None and not peer_rpc
         eng = None
-        if (
-            self.s.global_engine is not None
-            and not peer_rpc
-            and is_global.any()
-        ):
+        if use_engine and is_global.any():
             eng = is_global & (cols.err == 0)
             if not eng.any():
                 eng = None
@@ -555,12 +610,11 @@ class FastPath:
             self.s.metrics.getratelimit_counter.labels("global").inc(
                 int(eng.sum())
             )
-        if is_global.any() and eng is None:
-            self._queue_global(
-                payload, cols,
-                np.flatnonzero(is_global & (cols.err == 0)),
-                as_update=True,
-            )
+        if is_global.any() and not use_engine:
+            # With a collective engine, GLOBAL lanes (errored included)
+            # belong to the engine path on the object flow — the RPC
+            # update manager is never consulted.
+            self._queue_global_updates(payload, cols, is_global)
         mr = (cols.behavior & _MULTI_REGION) != 0
         if mr.any():
             self._queue_multiregion(
@@ -789,15 +843,13 @@ class FastPath:
                 metas[int(i)] = self._owner_frame(
                     peers[int(owner[int(i)])].info().grpc_address.encode()
                 )
-            self._queue_global(payload, cols, gc_idx, as_update=False)
+            self._queue_global(payload, cols, gc_idx)
             if self.s.global_engine is None:
                 # Owner-side updates broadcast via the RPC manager only
                 # when no collective engine owns replication (the engine
                 # broadcasts through sync + the _engine_synced bridge).
-                self._queue_global(
-                    payload, cols,
-                    np.flatnonzero(is_global & owned & (cols.err == 0)),
-                    as_update=True,
+                self._queue_global_updates(
+                    payload, cols, is_global, owned=owned
                 )
 
         mr = (cols.behavior & _MULTI_REGION) != 0

@@ -94,6 +94,15 @@ def test_fast_lane_validation_errors(node, client):
     assert rs[1].error == "field 'unique_key' cannot be empty"
     assert rs[2].error == "" and rs[2].remaining == 4
     assert fp.served == before + 3
+    # Error precedence: an empty key AND an invalid Gregorian duration
+    # reports the validation error (the packer rejects before the
+    # Gregorian is ever evaluated — object-path order).
+    r = client.get_rate_limits([
+        RateLimitReq(name="x", unique_key="", hits=1, limit=5,
+                     duration=99,
+                     behavior=Behavior.DURATION_IS_GREGORIAN),
+    ])[0]
+    assert r.error == "field 'unique_key' cannot be empty"
 
 
 def test_fast_lane_leaky_and_gregorian(node, client):
@@ -949,7 +958,9 @@ def test_fastpath_differential_mixed_behaviors(frozen_clock):
                 if rng.random() < 0.15:
                     behavior |= 16  # MULTI_REGION
                 name = rng.choice(["ex", "ex", "ex", "sk", "sk"])
-                duration = 60_000
+                # Short durations + the 120s clock jumps below cross
+                # bucket expiry mid-stream.
+                duration = rng.choice([60_000, 60_000, 1_000, 100])
                 if name == "ex" and rng.random() < 0.08:
                     behavior |= 4   # DURATION_IS_GREGORIAN
                     duration = rng.choice([1, 4, 99])  # 99 = invalid
@@ -997,7 +1008,7 @@ def test_fastpath_differential_mixed_behaviors(frozen_clock):
                     if b else None
                 )
                 assert ta == tb, (step, k)
-            frozen_clock.advance(rng.choice([0, 100, 5_000]))
+            frozen_clock.advance(rng.choice([0, 100, 5_000, 120_000]))
         assert fp.served > 0
         await fp.close()
         await s_fast.close()
@@ -1065,3 +1076,21 @@ def test_mesh_global_engine_routed_multinode():
         cl.close()
     finally:
         c.stop()
+
+
+def test_errored_sketch_global_queues_nothing(sketch_node, sketch_client):
+    """A validation-errored GLOBAL request with a sketch-tier NAME must
+    not queue an exact-table broadcast (the object path strips GLOBAL
+    from sketch names unconditionally); an errored GLOBAL request with a
+    NON-sketch name queues its update (reference QueueUpdate-before-
+    algorithm) whose broadcast re-read then errors and is skipped."""
+    svc = sketch_node.daemons[0].service
+    rs = sketch_client.get_rate_limits([
+        RateLimitReq(name="per_ip", unique_key="", hits=1, limit=5,
+                     duration=60_000, behavior=Behavior.GLOBAL),
+        RateLimitReq(name="exactg", unique_key="", hits=1, limit=5,
+                     duration=60_000, behavior=Behavior.GLOBAL),
+    ])
+    assert rs[0].error == rs[1].error == "field 'unique_key' cannot be empty"
+    assert "per_ip_" not in svc.global_mgr._updates
+    assert "exactg_" in svc.global_mgr._updates
