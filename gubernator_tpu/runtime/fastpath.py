@@ -287,6 +287,16 @@ class FastPath:
         await self._queue.put(entry)
         return await entry.fut
 
+    def _decode_req(self, payload, cols, i: int):
+        """Decode ONE request's spliced wire frame into a RateLimitReq."""
+        from gubernator_tpu.net.grpc_api import req_from_pb
+        from gubernator_tpu.proto import gubernator_pb2 as pb
+
+        frame = payload[
+            cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]
+        ]
+        return req_from_pb(pb.GetRateLimitsReq.FromString(frame).requests[0])
+
     def _decode_unique(self, payload, cols, idx, last=False):
         """Yield (req, group_indices) for each UNIQUE key hash among the
         request indices `idx` — one protobuf decode per unique key (the
@@ -296,9 +306,6 @@ class FastPath:
         broadcast's zero-hit re-read uses the queued request's params —
         first-occurrence params would recreate the bucket differently
         on an algorithm/burst change within one batch."""
-        from gubernator_tpu.net.grpc_api import req_from_pb
-        from gubernator_tpu.proto import gubernator_pb2 as pb
-
         if not len(idx):
             return
         order = idx[np.argsort(cols.hash[idx], kind="stable")]
@@ -310,11 +317,7 @@ class FastPath:
             hi = bounds[b_i + 1] if b_i + 1 < len(bounds) else len(order)
             group = order[lo:hi]
             fi = int(group[-1] if last else group[0])
-            frame = payload[
-                cols.msg_off[fi]:cols.msg_off[fi] + cols.msg_len[fi]
-            ]
-            m = pb.GetRateLimitsReq.FromString(frame).requests[0]
-            yield req_from_pb(m), group
+            yield self._decode_req(payload, cols, fi), group
 
     def _queue_global(self, payload, cols, idx) -> None:
         """Queue GLOBAL hits (non-owner) for the request indices `idx` —
@@ -341,9 +344,15 @@ class FastPath:
         The fast lane reproduces that exactly: the LAST arrival per key
         wins, valid or not.
 
-        `owned` (routed path) masks node-owned lanes; errored lanes have
-        their device hash zeroed, so their ownership is decided from the
-        decoded key string like the object path's routing does."""
+        `owned` (routed path) masks node-owned lanes.  Which branch an
+        errored lane takes depends on where its error was detected:
+        validation errors (empty name/key) have hash 0 from the parser
+        and route through the decode branch below, with ownership
+        decided from the decoded key string like the object path's
+        routing; Gregorian errors on the ROUTED path keep their true
+        hash in `cols` (only serve_local's subset copy was zeroed), so
+        they group with the valid lanes — same last-write-wins outcome
+        either way."""
         idx = np.flatnonzero(is_global)
         if not len(idx):
             return
@@ -358,17 +367,12 @@ class FastPath:
             best[req.hash_key()] = (int(group[-1]), req)
         err_lanes = idx[hv == 0]
         if len(err_lanes):
-            from gubernator_tpu.net.grpc_api import req_from_pb
-            from gubernator_tpu.proto import gubernator_pb2 as pb
+            from gubernator_tpu.runtime.service import PoolEmptyError
 
             sk_be = self.s.sketch_backend
             for i in err_lanes:
                 i = int(i)
-                frame = payload[
-                    cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]
-                ]
-                m = pb.GetRateLimitsReq.FromString(frame).requests[0]
-                req = req_from_pb(m)
+                req = self._decode_req(payload, cols, i)
                 if sk_be is not None and sk_be.handles(req):
                     # The object path strips GLOBAL from sketch names
                     # unconditionally (errored or not) — a sketch key
@@ -379,7 +383,7 @@ class FastPath:
                     try:
                         if not self.s.get_peer(key).info().is_owner:
                             continue
-                    except Exception:  # noqa: BLE001 — PoolEmptyError
+                    except PoolEmptyError:
                         continue
                 cur = best.get(key)
                 if cur is None or i > cur[0]:
@@ -799,15 +803,8 @@ class FastPath:
             """Re-route failed forwards through the object path's retry
             loop (ownership changes, NotReady backoff — service._forward).
             """
-            from gubernator_tpu.net.grpc_api import req_from_pb
-            from gubernator_tpu.proto import gubernator_pb2 as pb
-
             async def one(i: int) -> None:
-                frame = payload[
-                    cols.msg_off[i]:cols.msg_off[i] + cols.msg_len[i]
-                ]
-                m = pb.GetRateLimitsReq.FromString(frame).requests[0]
-                req = req_from_pb(m)
+                req = self._decode_req(payload, cols, i)
                 resp = await self.s._forward(peer, req, req.hash_key())
                 status[i] = int(resp.status)
                 out_lim[i] = resp.limit

@@ -1094,3 +1094,117 @@ def test_errored_sketch_global_queues_nothing(sketch_node, sketch_client):
     assert rs[0].error == rs[1].error == "field 'unique_key' cannot be empty"
     assert "per_ip_" not in svc.global_mgr._updates
     assert "exactg_" in svc.global_mgr._updates
+
+
+def test_multinode_routed_wire_differential(frozen_clock):
+    """Routed-path differential through REAL sockets: the same mixed
+    stream against two sequential 2-daemon clusters on IDENTICAL fixed
+    ports (=> identical vnode rings), one serving on the fast lane and
+    one with it detached — responses AND every daemon's stored rows must
+    match bit-for-bit, with GLOBAL hit/broadcast flushes driven at
+    identical stream points."""
+    import random
+
+    from gubernator_tpu.client import AsyncV1Client
+    from gubernator_tpu.core import clock as clock_mod
+    from gubernator_tpu.core.config import fast_test_behaviors
+    from gubernator_tpu.core.types import PeerInfo
+    from gubernator_tpu.daemon import Daemon, wait_for_connect
+
+    t0 = frozen_clock.millisecond_now()
+    keys = [f"rd{i}" for i in range(6)]
+
+    async def run_once(disable_fp):
+        clock_mod.freeze(at_ns=t0 * 1_000_000)
+        daemons = []
+        for i in range(2):
+            conf = DaemonConfig(
+                grpc_listen_address=f"127.0.0.1:{29461 + i}",
+                http_listen_address=f"127.0.0.1:{29471 + i}",
+                behaviors=fast_test_behaviors(),
+                device=DeviceConfig(num_slots=4096, ways=8, batch_size=64),
+            )
+            d = Daemon(conf)
+            await d.start()
+            d.conf.advertise_address = d.grpc_address
+            daemons.append(d)
+        peers = [PeerInfo(grpc_address=d.grpc_address) for d in daemons]
+        for d in daemons:
+            await d.set_peers(peers)
+        await wait_for_connect([d.grpc_address for d in daemons])
+        for d in daemons:
+            mgr = d.service.global_mgr
+            for t in mgr._tasks:
+                t.cancel()
+            await asyncio.gather(*mgr._tasks, return_exceptions=True)
+            mgr._tasks = []
+        if disable_fp:
+            for d in daemons:
+                d.fastpath = None
+        cl = AsyncV1Client(daemons[0].grpc_address)
+        rng = random.Random(77)
+        outs = []
+        for step in range(10):
+            n = rng.randint(1, 40)
+            reqs = []
+            for _ in range(n):
+                behavior = 0
+                if rng.random() < 0.2:
+                    behavior |= 2   # GLOBAL
+                if rng.random() < 0.08:
+                    behavior |= 8   # RESET_REMAINING
+                key = rng.choice(keys)
+                if rng.random() < 0.04:
+                    key = ""
+                reqs.append(RateLimitReq(
+                    name="rt", unique_key=key,
+                    hits=rng.choice([0, 1, 1, 2, -1]),
+                    limit=rng.choice([20, 30]),
+                    duration=rng.choice([60_000, 1_000]),
+                    algorithm=Algorithm(rng.choice([0, 1])),
+                    behavior=Behavior(behavior),
+                    burst=rng.choice([0, 0, 25]),
+                ))
+            rs = await cl.get_rate_limits(reqs)
+            outs.append([
+                (r.error, int(r.status), r.limit, r.remaining,
+                 r.reset_time, tuple(sorted(r.metadata.items())))
+                for r in rs
+            ])
+            # Deterministic flushes: hits reach owners, then broadcasts.
+            for d in daemons:
+                mgr = d.service.global_mgr
+                hits = mgr._take_hits()
+                if hits:
+                    await mgr._send_hits(hits)
+            for d in daemons:
+                mgr = d.service.global_mgr
+                upd = mgr._take_updates()
+                if upd:
+                    await mgr._broadcast_peers(upd)
+            state = []
+            for d in daemons:
+                for k in keys:
+                    it = d.service.backend.get_cache_item(f"rt_{k}")
+                    state.append(
+                        (it.remaining, it.expire_at, int(it.status),
+                         it.limit) if it else None
+                    )
+            outs.append(state)
+            clock_mod.advance(rng.choice([0, 100, 5_000]))
+        await cl.close()
+        served = sum(
+            d.fastpath.served for d in daemons if d.fastpath is not None
+        )
+        for d in daemons:
+            await d.close()
+        return outs, served
+
+    async def scenario():
+        fast, served = await run_once(disable_fp=False)
+        assert served > 0  # the lane actually ran in run A
+        obj, _ = await run_once(disable_fp=True)
+        for step, (a, b) in enumerate(zip(fast, obj)):
+            assert a == b, f"divergence at record {step}"
+
+    asyncio.new_event_loop().run_until_complete(scenario())
