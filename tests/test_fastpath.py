@@ -893,7 +893,8 @@ def test_mesh_global_engine_background_sync_fires():
         c.stop()
 
 
-def test_fastpath_differential_mixed_behaviors(frozen_clock):
+@pytest.mark.parametrize("seed", [31, 9, 1])
+def test_fastpath_differential_mixed_behaviors(frozen_clock, seed):
     """Randomized wire-level differential across the WHOLE behavior
     surface the fast lane serves: exact token/leaky, GLOBAL,
     MULTI_REGION, RESET_REMAINING, Gregorian (valid and invalid),
@@ -945,7 +946,7 @@ def test_fastpath_differential_mixed_behaviors(frozen_clock):
                     await svc.global_mgr._send_hits(hits)
 
         fp = FastPath(s_fast)
-        rng = random.Random(31)
+        rng = random.Random(seed)
         for step in range(25):
             n = rng.randint(1, 60)
             reqs = []
