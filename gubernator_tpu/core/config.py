@@ -150,6 +150,14 @@ class DaemonConfig:
     store: Optional[object] = None
     # Approximate (count-min sketch) tier for selected limit names.
     sketch: Optional[SketchTierConfig] = None
+    # Compiled fast lane pipeline depth: how many coalesced device
+    # merges may be in flight at once.  Depth 1 means every drain takes
+    # the WHOLE queue as one maximal merge — measured 2x faster than
+    # depth 3 on a high-latency device link (fewer response syncs beats
+    # overlapping them: 51k vs 24k checks/s through a ~65ms-RTT tunnel,
+    # monotone across depths 1>2>3>4>6).  Raise only if profiling shows
+    # host-side gather/serialize starving the device between merges.
+    fastpath_inflight: int = 1
 
 
 @dataclass
@@ -189,6 +197,14 @@ def _env_float_s(name: str, default: float) -> float:
     if v in (None, ""):
         return default
     return parse_duration_s(v)
+
+
+def _require_min(name: str, value: int, lo: int) -> int:
+    """Fail at config parse with the env-var name instead of letting an
+    out-of-range value crash deep inside a constructor."""
+    if value < lo:
+        raise ValueError(f"{name} must be >= {lo}, got {value}")
+    return value
 
 
 def parse_duration_s(v: str) -> float:
@@ -295,6 +311,10 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         # Bit 1 = process/platform/GC collectors (the GUBER_METRIC_FLAGS
         # golang/process flags, daemon.go:255-266, flags.go:19-56).
         metric_flags=_env_int("GUBER_METRIC_FLAGS", 0),
+        fastpath_inflight=_require_min(
+            "GUBER_FASTPATH_INFLIGHT",
+            _env_int("GUBER_FASTPATH_INFLIGHT", 1), 1,
+        ),
     )
 
 

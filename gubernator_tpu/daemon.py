@@ -224,7 +224,10 @@ class Daemon:
         await self.service.start()
         from gubernator_tpu.runtime.fastpath import FastPath
 
-        self.fastpath = FastPath(self.service)
+        self.fastpath = FastPath(
+            self.service,
+            max_inflight=getattr(self.conf, "fastpath_inflight", 1),
+        )
 
         # gRPC server (daemon.go:101-126): both services on one listener.
         # 4MB recv cap: grpc-go's default, which reference peers assume.
