@@ -1110,8 +1110,9 @@ class FastPath:
         if plan is None or not len(plan.groups):
             # Plain merge (cached-read dedup included — its single lane is
             # atomic within the machinery): dispatch under the backend
-            # lock, sync outside — merges pipeline against each other's
-            # response round-trips.
+            # lock, sync outside — arrivals keep accumulating into the
+            # NEXT maximal merge while this one's response syncs (and at
+            # fastpath_inflight > 1, merges overlap their round-trips).
             host = backend.step_rounds(rounds, add_tally=False)
             gather(host)
         else:
