@@ -1056,12 +1056,6 @@ class FastPath:
             h_mach[plan.occ] = 0          # divert cascade occurrences
             h_mach[plan.firsts] = h[plan.firsts]  # keep one READ lane
             hits_mach[plan.firsts] = 0
-            # Cached-read groups: one serving lane (its own hits), the
-            # rest share its response.
-            if len(plan.read_groups):
-                rf = plan.first_idx[plan.read_groups]
-                h_mach[plan.read_occ] = 0
-                h_mach[rf] = h[rf]
 
         if n_shards > 1:
             from gubernator_tpu.parallel.mesh import shard_of_hash
@@ -1107,12 +1101,11 @@ class FastPath:
                 reset[sel] = hr["reset_time"][idx]
                 stored[sel] = hr["stored"][idx]
 
-        if plan is None or not len(plan.groups):
-            # Plain merge (cached-read dedup included — its single lane is
-            # atomic within the machinery): dispatch under the backend
-            # lock, sync outside — arrivals keep accumulating into the
-            # NEXT maximal merge while this one's response syncs (and at
-            # fastpath_inflight > 1, merges overlap their round-trips).
+        if plan is None:
+            # Plain merge: dispatch under the backend lock, sync outside
+            # — arrivals keep accumulating into the NEXT maximal merge
+            # while this one's response syncs (and at fastpath_inflight
+            # > 1, merges overlap their round-trips).
             host = backend.step_rounds(rounds, add_tally=False)
             gather(host)
         else:
@@ -1129,7 +1122,7 @@ class FastPath:
                 wb = _run_cascade(
                     plan, h, hits, lim, dur, algo, burst,
                     status, out_lim, remaining, reset, stored,
-                )  # noqa: E501 — read-group copy happens after the branch
+                )
                 if wb is not None:
                     wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
                     wb_sh = (
@@ -1155,17 +1148,6 @@ class FastPath:
                         wn, n_shards, B,
                     )
                     backend._dispatch_rounds_locked(wb_rounds)
-
-        if plan is not None and len(plan.read_groups):
-            # Cached-read dedup: duplicates share the serving lane's
-            # response (the GLOBAL engine's documented aggregation
-            # semantics, parallel/global_sync.py GlobalEngine.check).
-            ri = np.flatnonzero(plan.read_occ)
-            src = plan.first_idx[plan.inv[ri]]
-            status[ri] = status[src]
-            out_lim[ri] = out_lim[src]
-            remaining[ri] = remaining[src]
-            reset[ri] = reset[src]
 
         # Metric parity: checks/over-limit from the per-REQUEST outputs
         # (cascade occurrences never had their own device lane); cache
@@ -1255,19 +1237,13 @@ def _build_rounds(values, rnd, lane, sh_all, n_rounds, n_shards, B):
 
 
 class _CascadePlan:
-    __slots__ = ("occ", "firsts", "groups", "inv", "read_occ",
-                 "read_groups", "first_idx")
+    __slots__ = ("occ", "firsts", "groups", "inv", "first_idx")
 
-    def __init__(self, occ, firsts, groups, inv, read_occ, read_groups,
-                 first_idx):
+    def __init__(self, occ, firsts, groups, inv, first_idx):
         self.occ = occ          # bool[n]: occurrence is in a cascade group
         self.firsts = firsts    # int[-]: first-occurrence index per group
         self.groups = groups    # int[-]: group ids (into inv's codomain)
         self.inv = inv          # int[n]: np.unique inverse (key group id)
-        # Cached-read dedup (GLOBAL non-owner lanes): duplicate use_cached
-        # groups keep ONE lane and share its response.
-        self.read_occ = read_occ      # bool[n]
-        self.read_groups = read_groups  # int[-]: group ids
         self.first_idx = first_idx    # int[nb]: first occurrence per group
 
 
@@ -1283,13 +1259,10 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
     over-more / under) is then a pure function of the running remaining,
     replayable on host from the read lane's post-step `stored` value.
 
-    Cached-read groups: >1 occurrence where EVERY occurrence is a
-    use_cached lane (GLOBAL non-owner serving) with identical params —
-    one lane serves, duplicates share its response (the hit aggregation
-    already rode the GLOBAL queue per entry; matches the collective
-    engine's documented dedup, parallel/global_sync.py).
-
-    Anything else keeps the exact round-per-occurrence machinery."""
+    Anything else — including duplicate use_cached (GLOBAL non-owner)
+    lanes, whose per-occurrence interim decrements must match the
+    object path's rounds exactly — keeps the round-per-occurrence
+    machinery."""
     uniq, first_idx, inv, counts = np.unique(
         h, return_index=True, return_inverse=True, return_counts=True
     )
@@ -1310,20 +1283,13 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
     ) > 0
     casc = dup & ~grp_bad & same
 
-    grp_uncached = np.bincount(
-        inv, weights=(~use_cached).astype(np.float64), minlength=nb
-    ) > 0
-    reads = dup & ~grp_uncached & same
-
-    if not casc.any() and not reads.any():
+    if not casc.any():
         return None
     return _CascadePlan(
         occ=casc[inv],
         firsts=first_idx[casc],
         groups=np.flatnonzero(casc),
         inv=inv,
-        read_occ=reads[inv],
-        read_groups=np.flatnonzero(reads),
         first_idx=first_idx,
     )
 

@@ -1097,6 +1097,75 @@ def test_errored_sketch_global_queues_nothing(sketch_node, sketch_client):
     assert "exactg_" in svc.global_mgr._updates
 
 
+async def _diff_pair_start(grpc_base, http_base, device, disable_fp):
+    """Two-daemon pair on FIXED ports (identical vnode rings across
+    sequential runs), background flush loops cancelled for deterministic
+    replication, fast lane optionally detached — the shared harness of
+    the sequential wire differentials."""
+    from gubernator_tpu.core.config import fast_test_behaviors
+    from gubernator_tpu.core.types import PeerInfo
+    from gubernator_tpu.daemon import Daemon, wait_for_connect
+
+    daemons = []
+    for i in range(2):
+        conf = DaemonConfig(
+            grpc_listen_address=f"127.0.0.1:{grpc_base + i}",
+            http_listen_address=f"127.0.0.1:{http_base + i}",
+            behaviors=fast_test_behaviors(),
+            device=device,
+        )
+        d = Daemon(conf)
+        await d.start()
+        d.conf.advertise_address = d.grpc_address
+        daemons.append(d)
+    peers = [PeerInfo(grpc_address=d.grpc_address) for d in daemons]
+    for d in daemons:
+        await d.set_peers(peers)
+    await wait_for_connect([d.grpc_address for d in daemons])
+    for d in daemons:
+        svc = d.service
+        lp = svc._collective_loop
+        if lp is not None and lp._task is not None:
+            lp._task.cancel()
+            await asyncio.gather(lp._task, return_exceptions=True)
+            lp._task = None
+        mgr = svc.global_mgr
+        for t in mgr._tasks:
+            t.cancel()
+        await asyncio.gather(*mgr._tasks, return_exceptions=True)
+        mgr._tasks = []
+    if disable_fp:
+        for d in daemons:
+            d.fastpath = None
+    return daemons
+
+
+async def _diff_pair_flush_hits(daemons):
+    for d in daemons:
+        mgr = d.service.global_mgr
+        hits = mgr._take_hits()
+        if hits:
+            await mgr._send_hits(hits)
+
+
+async def _diff_pair_broadcast(daemons):
+    for d in daemons:
+        mgr = d.service.global_mgr
+        upd = mgr._take_updates()
+        if upd:
+            await mgr._broadcast_peers(upd)
+
+
+async def _diff_pair_finish(daemons, cl):
+    await cl.close()
+    served = sum(
+        d.fastpath.served for d in daemons if d.fastpath is not None
+    )
+    for d in daemons:
+        await d.close()
+    return served
+
+
 def test_multinode_routed_wire_differential(frozen_clock):
     """Routed-path differential through REAL sockets: the same mixed
     stream against two sequential 2-daemon clusters on IDENTICAL fixed
@@ -1108,40 +1177,17 @@ def test_multinode_routed_wire_differential(frozen_clock):
 
     from gubernator_tpu.client import AsyncV1Client
     from gubernator_tpu.core import clock as clock_mod
-    from gubernator_tpu.core.config import fast_test_behaviors
-    from gubernator_tpu.core.types import PeerInfo
-    from gubernator_tpu.daemon import Daemon, wait_for_connect
 
     t0 = frozen_clock.millisecond_now()
     keys = [f"rd{i}" for i in range(6)]
 
     async def run_once(disable_fp):
         clock_mod.freeze(at_ns=t0 * 1_000_000)
-        daemons = []
-        for i in range(2):
-            conf = DaemonConfig(
-                grpc_listen_address=f"127.0.0.1:{29461 + i}",
-                http_listen_address=f"127.0.0.1:{29471 + i}",
-                behaviors=fast_test_behaviors(),
-                device=DeviceConfig(num_slots=4096, ways=8, batch_size=64),
-            )
-            d = Daemon(conf)
-            await d.start()
-            d.conf.advertise_address = d.grpc_address
-            daemons.append(d)
-        peers = [PeerInfo(grpc_address=d.grpc_address) for d in daemons]
-        for d in daemons:
-            await d.set_peers(peers)
-        await wait_for_connect([d.grpc_address for d in daemons])
-        for d in daemons:
-            mgr = d.service.global_mgr
-            for t in mgr._tasks:
-                t.cancel()
-            await asyncio.gather(*mgr._tasks, return_exceptions=True)
-            mgr._tasks = []
-        if disable_fp:
-            for d in daemons:
-                d.fastpath = None
+        daemons = await _diff_pair_start(
+            29461, 29471,
+            DeviceConfig(num_slots=4096, ways=8, batch_size=64),
+            disable_fp,
+        )
         cl = AsyncV1Client(daemons[0].grpc_address)
         rng = random.Random(77)
         outs = []
@@ -1173,16 +1219,8 @@ def test_multinode_routed_wire_differential(frozen_clock):
                 for r in rs
             ])
             # Deterministic flushes: hits reach owners, then broadcasts.
-            for d in daemons:
-                mgr = d.service.global_mgr
-                hits = mgr._take_hits()
-                if hits:
-                    await mgr._send_hits(hits)
-            for d in daemons:
-                mgr = d.service.global_mgr
-                upd = mgr._take_updates()
-                if upd:
-                    await mgr._broadcast_peers(upd)
+            await _diff_pair_flush_hits(daemons)
+            await _diff_pair_broadcast(daemons)
             state = []
             for d in daemons:
                 for k in keys:
@@ -1193,17 +1231,92 @@ def test_multinode_routed_wire_differential(frozen_clock):
                     )
             outs.append(state)
             clock_mod.advance(rng.choice([0, 100, 5_000]))
-        await cl.close()
-        served = sum(
-            d.fastpath.served for d in daemons if d.fastpath is not None
-        )
-        for d in daemons:
-            await d.close()
+        served = await _diff_pair_finish(daemons, cl)
         return outs, served
 
     async def scenario():
         fast, served = await run_once(disable_fp=False)
         assert served > 0  # the lane actually ran in run A
+        obj, _ = await run_once(disable_fp=True)
+        for step, (a, b) in enumerate(zip(fast, obj)):
+            assert a == b, f"divergence at record {step}"
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_mesh_cluster_wire_differential(frozen_clock):
+    """Mesh-cluster differential through real sockets: two sequential
+    2-daemon MESH clusters on identical fixed ports, fast lane on vs
+    detached, GLOBAL-heavy traffic — responses, both auth tables, the
+    engines' replicated caches, and pending queues must match, with
+    hits-flush -> collective sync -> broadcast driven at identical
+    stream points."""
+    import random
+
+    from gubernator_tpu.client import AsyncV1Client
+    from gubernator_tpu.core import clock as clock_mod
+
+    t0 = frozen_clock.millisecond_now()
+    keys = [f"mg{i}" for i in range(6)]
+    dev = DeviceConfig(
+        num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+    )
+
+    async def run_once(disable_fp):
+        clock_mod.freeze(at_ns=t0 * 1_000_000)
+        daemons = await _diff_pair_start(29481, 29491, dev, disable_fp)
+        cl = AsyncV1Client(daemons[0].grpc_address)
+        rng = random.Random(55)
+        loop = asyncio.get_running_loop()
+        outs = []
+        for step in range(8):
+            n = rng.randint(1, 30)
+            reqs = []
+            for _ in range(n):
+                behavior = 2 if rng.random() < 0.6 else 0  # GLOBAL-heavy
+                reqs.append(RateLimitReq(
+                    name="mg", unique_key=rng.choice(keys),
+                    hits=rng.choice([1, 1, 2]),
+                    limit=50, duration=60_000,
+                    behavior=Behavior(behavior),
+                ))
+            rs = await cl.get_rate_limits(reqs)
+            outs.append([
+                (r.error, int(r.status), r.limit, r.remaining,
+                 r.reset_time, tuple(sorted(r.metadata.items())))
+                for r in rs
+            ])
+            # Deterministic replication: hits -> collective sync ->
+            # bridge callbacks -> broadcasts, same points both runs.
+            await _diff_pair_flush_hits(daemons)
+            for d in daemons:
+                await loop.run_in_executor(
+                    d.service._dev_executor, d.service.global_engine.sync
+                )
+            await asyncio.sleep(0)  # let _engine_synced callbacks land
+            await _diff_pair_broadcast(daemons)
+            state = []
+            for d in daemons:
+                svc = d.service
+                for k in keys:
+                    it = svc.backend.get_cache_item(f"mg_{k}")
+                    state.append(
+                        (it.remaining, it.expire_at, int(it.status))
+                        if it else None
+                    )
+                    state.append(svc.global_engine.get_cached(f"mg_{k}"))
+                state.append(sorted(
+                    (k, p.hits)
+                    for k, p in svc.global_engine.pending.items()
+                ))
+            outs.append(state)
+            clock_mod.advance(rng.choice([0, 100, 5_000]))
+        served = await _diff_pair_finish(daemons, cl)
+        return outs, served
+
+    async def scenario():
+        fast, served = await run_once(disable_fp=False)
+        assert served > 0
         obj, _ = await run_once(disable_fp=True)
         for step, (a, b) in enumerate(zip(fast, obj)):
             assert a == b, f"divergence at record {step}"
