@@ -60,6 +60,10 @@ class Resp(NamedTuple):
     # Seeds the fast lane's host-side duplicate cascade
     # (runtime/fastpath.py).
     stored: jax.Array     # int64[B]
+    # Lane answered VERBATIM from a live KIND_CACHED_RESP row (the GLOBAL
+    # broadcast read path) — no mutation happened; the fast lane's cached
+    # duplicate cascade branches on this.
+    cached: jax.Array     # bool[B]
 
 
 class DeviceBatchJ(NamedTuple):
@@ -364,6 +368,7 @@ def apply_batch_impl(
                 te_rem, tn_rem, _trunc_i64(lb4), _trunc_i64(ln_rem_f), r_lim
             ),
         ),
+        cached=cached_hit,
     )
 
     # ==== write back ====================================================
@@ -558,11 +563,12 @@ def apply_batch_packed_impl(
     now: jax.Array,
     ways: int = 8,
 ) -> Tuple[SlotTable, jax.Array]:
-    """apply_batch with the response packed into ONE int64[7, B] array —
-    a single device->host transfer per step instead of seven.  Matters when
+    """apply_batch with the response packed into ONE int64[8, B] array —
+    a single device->host transfer per step instead of eight.  Matters when
     the host link has per-transfer latency (e.g. remote-device tunnels).
 
-    Rows: status, limit, remaining, reset_time, persisted, found, stored.
+    Rows: status, limit, remaining, reset_time, persisted, found, stored,
+    cached.
     """
     new_table, r = apply_batch_impl(table, batch, now, ways)
     packed = jnp.stack([
@@ -573,6 +579,7 @@ def apply_batch_packed_impl(
         r.persisted.astype(jnp.int64),
         r.found.astype(jnp.int64),
         r.stored.astype(jnp.int64),
+        r.cached.astype(jnp.int64),
     ])
     return new_table, packed
 
@@ -601,7 +608,7 @@ def apply_batch_packed_q_impl(
     ways: int = 8,
 ) -> Tuple[SlotTable, jax.Array]:
     """Fully packed step: ONE int64[12, B] host->device transfer in, ONE
-    int64[7, B] transfer out.  Per-transfer link latency (remote-device
+    int64[8, B] transfer out.  Per-transfer link latency (remote-device
     tunnels) makes the 12-arrays-in form 12x more expensive; this is the
     single-device analog of the mesh path's pack_grid_batch."""
     return apply_batch_packed_impl(table, unpack_batch_q(q), now, ways)

@@ -124,7 +124,7 @@ def make_sharded_step_packed(mesh, ways: int):
 
 
 def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed [n, 7, B] responses — one transfer per round.
+    """Host view of packed [n, 8, B] responses — one transfer per round.
     Field arrays are [n, B], so (shard, lane) positions index directly."""
     out = []
     for p in round_resps:
@@ -137,6 +137,7 @@ def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "persisted": a[:, 4],
             "found": a[:, 5],
             "stored": a[:, 6],
+            "cached": a[:, 7],
         })
     return out
 

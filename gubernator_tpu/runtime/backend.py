@@ -663,13 +663,14 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "persisted": np.asarray(r.persisted),
             "found": np.asarray(r.found),
             "stored": np.asarray(r.stored),
+            "cached": np.asarray(r.cached),
         }
         for r in round_resps
     ]
 
 
 def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed int64[7, B] responses (apply_batch_packed row
+    """Host view of packed int64[8, B] responses (apply_batch_packed row
     order), one transfer per round."""
     out = []
     for p in round_packed:
@@ -682,6 +683,7 @@ def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
             "persisted": a[4],
             "found": a[5],
             "stored": a[6],
+            "cached": a[7],
         })
     return out
 

@@ -190,22 +190,23 @@ class FastPath:
         self._pool = ThreadPoolExecutor(
             max_workers=max_inflight, thread_name_prefix="tpu-fastlane"
         )
-        # Engine branches run per-RPC (no cross-RPC coalescing yet) on
-        # their own small pool so a machinery merge's response sync never
-        # serializes them; deep engine concurrency still queues here.
-        self._aux_pool = ThreadPoolExecutor(
-            max_workers=max(4, max_inflight + 1),
-            thread_name_prefix="tpu-fastlane-aux",
-        )
         self._mach = _Coalescer(self._pool, self._process, max_inflight)
-        # The sketch lane coalesces cross-RPC into one merge at a time —
-        # a DEDICATED worker so engine/machinery syncs can't starve it.
+        # The sketch and engine lanes each coalesce cross-RPC into one
+        # maximal merge at a time, on DEDICATED workers so machinery
+        # syncs can't starve them (and vice versa).
         self._sketch_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpu-fastlane-sketch"
         )
         self._sketch_lane = (
             _Coalescer(self._sketch_pool, self._sketch_process)
             if service.sketch_backend is not None else None
+        )
+        self._engine_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-fastlane-engine"
+        )
+        self._engine_lane = (
+            _Coalescer(self._engine_pool, self._engine_process)
+            if service.global_engine is not None else None
         )
         # Servings since start (observability; also asserted in tests to
         # prove the fast lane actually ran).
@@ -549,11 +550,8 @@ class FastPath:
             reset[sk_idx] = rst
 
         async def run_engine() -> None:
-            st, lm, rem, rst = await loop.run_in_executor(
-                self._aux_pool,
-                lambda: self._engine_cols(
-                    payload, cols, eng_idx, is_greg, ge, gd
-                ),
+            st, lm, rem, rst = await self._engine_lane.do(
+                _EngineEntry(payload, cols, eng_idx, is_greg, ge, gd)
             )
             status[eng_idx] = st
             out_lim[eng_idx] = lm
@@ -561,7 +559,7 @@ class FastPath:
             reset[eng_idx] = rst
             # Open the sync window for the queued hits (the object
             # path's notify at service.py:405; asyncio.Event — must run
-            # on the loop thread, hence here and not in _engine_cols).
+            # on the loop thread, hence here and not in _engine_process).
             if self.s._collective_loop is not None:
                 self.s._collective_loop.notify()
 
@@ -588,18 +586,17 @@ class FastPath:
         await asyncio.gather(*tasks)
         return status, out_lim, remaining, reset
 
-    def _engine_cols(
-        self, payload, cols, idx, is_greg, ge, gd
-    ) -> Tuple[np.ndarray, ...]:
-        """Columnar serving for node-owned GLOBAL lanes on the mesh
-        GlobalEngine (runs on a fast-lane pool thread).
+    def _engine_process(self, entries) -> List[Tuple[np.ndarray, ...]]:
+        """Merged columnar serving for node-owned GLOBAL lanes on the
+        mesh GlobalEngine — one coalescer drain = ONE engine lock hold
+        and dispatch chain (runs on the engine lane's worker thread).
 
-        Mirrors GlobalEngine.check: duplicates aggregate to ONE lane per
-        unique key (hits summed, first occurrence's params; the response
-        is shared — the engine's documented dedup), lanes route to their
-        arrival device, the ingest runs use_cached on the replicated
-        cache table, and pending hits queue for the next collective
-        sync."""
+        Per ENTRY, duplicates aggregate to one lane per unique key
+        (hits summed, first occurrence's params, shared response) —
+        mirroring one GlobalEngine.check call.  ACROSS entries the same
+        key keeps separate lanes, which assign_rounds places in later
+        rounds — so a drain of N entries is semantically N sequential
+        engine calls, amortized into one round-trip."""
         from gubernator_tpu.parallel.sharded import (
             packed_grid_rounds_to_host,
         )
@@ -611,54 +608,77 @@ class FastPath:
         engine = self.s.global_engine
         cfg = self.s.backend.cfg
         n_shards, B = cfg.num_shards, cfg.batch_size
-        sub_h = cols.hash[idx]
-        uniq, first, inv = np.unique(
-            sub_h, return_index=True, return_inverse=True
-        )
-        rep = idx[first]                       # first occurrence per key
-        m = len(uniq)
-        # Exact int64 sums (float64 bincount weights would corrupt hits
-        # above 2^53 and diverge from the pending queue's exact sums).
-        hits_sum = np.zeros(m, dtype=np.int64)
-        np.add.at(hits_sum, inv, cols.hits[idx])
-        lim = cols.limit[rep]
-        burst = cols.burst[rep]
-        burst = np.where(burst == 0, lim, burst)
         shift = np.uint64(44)  # _ARRIVAL_SHIFT; vectorized arrival_dev
+
+        per = []
+        for e in entries:
+            sub_h = e.cols.hash[e.idx]
+            uniq, first, inv = np.unique(
+                sub_h, return_index=True, return_inverse=True
+            )
+            rep = e.idx[first]             # first occurrence per key
+            m = len(uniq)
+            # Exact int64 sums (float64 bincount weights would corrupt
+            # hits above 2^53 and diverge from the pending queue).
+            hits_sum = np.zeros(m, dtype=np.int64)
+            np.add.at(hits_sum, inv, e.cols.hits[e.idx])
+            burst = e.cols.burst[rep]
+            burst = np.where(burst == 0, e.cols.limit[rep], burst)
+            per.append((e, uniq, inv, rep, m, hits_sum, burst))
+
+        def cat(parts):
+            # Uncontended drains (one entry) skip the copies.
+            return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+        h_all = cat([p[1] for p in per])
+        offs = np.zeros(len(per) + 1, dtype=np.int64)
+        np.cumsum([p[4] for p in per], out=offs[1:])
         sh = (
-            (uniq.view(np.uint64) >> shift) % np.uint64(n_shards)
+            (h_all.view(np.uint64) >> shift) % np.uint64(n_shards)
         ).astype(np.int32)
-        rnd, lane, n_rounds = native.assign_rounds(uniq, sh, n_shards, B)
+        rnd, lane, n_rounds = native.assign_rounds(h_all, sh, n_shards, B)
         values = dict(
-            key_hash=uniq, hits=hits_sum, limit=lim,
-            duration=cols.duration[rep], algo=cols.algo[rep],
-            burst=burst,
-            reset_remaining=(
-                cols.behavior[rep] & int(Behavior.RESET_REMAINING)
-            ) != 0,
-            is_greg=is_greg[rep], greg_expire=ge[rep],
-            greg_duration=gd[rep],
-            use_cached=np.ones(m, dtype=bool),
+            key_hash=h_all,
+            hits=cat([p[5] for p in per]),
+            limit=cat([p[0].cols.limit[p[3]] for p in per]),
+            duration=cat([p[0].cols.duration[p[3]] for p in per]),
+            algo=cat([p[0].cols.algo[p[3]] for p in per]),
+            burst=cat([p[6] for p in per]),
+            reset_remaining=cat([
+                (p[0].cols.behavior[p[3]]
+                 & int(Behavior.RESET_REMAINING)) != 0
+                for p in per
+            ]),
+            is_greg=cat([p[0].is_greg[p[3]] for p in per]),
+            greg_expire=cat([p[0].ge[p[3]] for p in per]),
+            greg_duration=cat([p[0].gd[p[3]] for p in per]),
+            use_cached=np.ones(len(h_all), dtype=bool),
         )
         rounds, order, bounds = _build_rounds(
             values, rnd, lane, sh, n_rounds, n_shards, B
         )
         # _decode_unique yields groups in ascending-hash order — exactly
-        # uniq's order — so the decoded reqs zip with the computed sums
-        # and arrival shards (one source of truth for both).
-        pend = [
-            (req, int(hits_sum[j]), int(sh[j]))
+        # each entry's uniq order — so the decoded reqs zip with the
+        # computed sums and arrival shards (one source of truth).
+        pend = []
+        for i, (e, _uniq, _inv, _rep, _m, hits_sum, _burst) in enumerate(
+            per
+        ):
+            off = int(offs[i])
             for j, (req, _group) in enumerate(
-                self._decode_unique(payload, cols, idx)
-            )
-        ]
+                self._decode_unique(e.payload, e.cols, e.idx)
+            ):
+                pend.append(
+                    (req, int(hits_sum[j]), int(sh[off + j]))
+                )
         resps, want_sync = engine.serve_packed(rounds, pend)
         host = packed_grid_rounds_to_host(resps)
 
-        st_u = np.zeros(m, dtype=np.int64)
-        lm_u = np.zeros(m, dtype=np.int64)
-        rem_u = np.zeros(m, dtype=np.int64)
-        rst_u = np.zeros(m, dtype=np.int64)
+        mt = len(h_all)
+        st_u = np.zeros(mt, dtype=np.int64)
+        lm_u = np.zeros(mt, dtype=np.int64)
+        rem_u = np.zeros(mt, dtype=np.int64)
+        rst_u = np.zeros(mt, dtype=np.int64)
         for r_idx in range(n_rounds):
             sel = order[bounds[r_idx]:bounds[r_idx + 1]]
             hr = host[r_idx]
@@ -670,14 +690,21 @@ class FastPath:
 
         t = tally_from_rounds(rounds, host)
         self.s.backend._add_tally(Tally(
-            checks=m,
+            checks=mt,
             over_limit=int((st_u == 1).sum()),
             not_persisted=t.not_persisted,
             cache_hits=t.cache_hits,
         ))
         if want_sync:
             engine.sync()
-        return st_u[inv], lm_u[inv], rem_u[inv], rst_u[inv]
+        outs: List[Tuple[np.ndarray, ...]] = []
+        for i, (_e, _uniq, inv, _rep, m, _hits, _burst) in enumerate(per):
+            lo, hi = int(offs[i]), int(offs[i + 1])
+            outs.append((
+                st_u[lo:hi][inv], lm_u[lo:hi][inv],
+                rem_u[lo:hi][inv], rst_u[lo:hi][inv],
+            ))
+        return outs
 
     @staticmethod
     def _sketch_meta(n: int, sk) -> Tuple[Optional[bytes],
@@ -1086,6 +1113,7 @@ class FastPath:
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
         stored = np.zeros(n, dtype=np.int64)
+        cachedv = np.zeros(n, dtype=np.int64)
 
         def gather(host) -> None:
             for r_idx in range(n_rounds):
@@ -1100,6 +1128,7 @@ class FastPath:
                 remaining[sel] = hr["remaining"][idx]
                 reset[sel] = hr["reset_time"][idx]
                 stored[sel] = hr["stored"][idx]
+                cachedv[sel] = hr["cached"][idx]
 
         if plan is None:
             # Plain merge: dispatch under the backend lock, sync outside
@@ -1121,7 +1150,7 @@ class FastPath:
                 gather(host)
                 wb = _run_cascade(
                     plan, h, hits, lim, dur, algo, burst,
-                    status, out_lim, remaining, reset, stored,
+                    status, out_lim, remaining, reset, stored, cachedv,
                 )
                 if wb is not None:
                     wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
@@ -1180,9 +1209,11 @@ class FastPath:
         await self._mach.close()
         if self._sketch_lane is not None:
             await self._sketch_lane.close()
+        if self._engine_lane is not None:
+            await self._engine_lane.close()
         self._pool.shutdown(wait=True)
-        self._aux_pool.shutdown(wait=True)
         self._sketch_pool.shutdown(wait=True)
+        self._engine_pool.shutdown(wait=True)
 
 
 class _Entry:
@@ -1212,6 +1243,21 @@ class _SketchEntry:
         self.kh = kh
         self.hits = hits
         self.limits = limits
+        self.fut = None
+
+
+class _EngineEntry:
+    """Engine-lane coalescer entry (fut assigned by _Coalescer.do)."""
+
+    __slots__ = ("payload", "cols", "idx", "is_greg", "ge", "gd", "fut")
+
+    def __init__(self, payload, cols, idx, is_greg, ge, gd):
+        self.payload = payload
+        self.cols = cols
+        self.idx = idx
+        self.is_greg = is_greg
+        self.ge = ge
+        self.gd = gd
         self.fut = None
 
 
@@ -1253,16 +1299,19 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
     round per occurrence.
 
     Exact-cascade groups: >1 occurrence of a key where every occurrence
-    has positive hits, no RESET_REMAINING, no Gregorian duration, no
-    use_cached flag, and identical limit/duration/algorithm/burst.  The
-    per-occurrence branch order of the kernel (over-at-zero / exact /
-    over-more / under) is then a pure function of the running remaining,
-    replayable on host from the read lane's post-step `stored` value.
+    has positive hits, no RESET_REMAINING, no Gregorian duration, and
+    identical limit/duration/algorithm/burst.  use_cached (GLOBAL
+    non-owner) groups qualify too when the flag is UNIFORM across the
+    group — the replay branches on the read lane's `cached` flag: a
+    verbatim broadcast-row serve copies to every occurrence (the device
+    mutates nothing on such reads), while a pre-broadcast bucket runs
+    the standard lattice replay.  The per-occurrence branch order of
+    the kernel (over-at-zero / exact / over-more / under) is a pure
+    function of the running remaining, replayable on host from the
+    read lane's post-step `stored` value.
 
-    Anything else — including duplicate use_cached (GLOBAL non-owner)
-    lanes, whose per-occurrence interim decrements must match the
-    object path's rounds exactly — keeps the round-per-occurrence
-    machinery."""
+    Mixed cached/uncached groups (ownership changed mid-stream) and
+    everything else keep the round-per-occurrence machinery."""
     uniq, first_idx, inv, counts = np.unique(
         h, return_index=True, return_inverse=True, return_counts=True
     )
@@ -1276,8 +1325,14 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
         same &= np.bincount(
             inv, weights=diff.astype(np.float64), minlength=nb
         ) == 0
+    cached_mixed = (
+        use_cached != use_cached[first_idx][inv]
+    )
+    same &= np.bincount(
+        inv, weights=cached_mixed.astype(np.float64), minlength=nb
+    ) == 0
 
-    bad_occ = (hits <= 0) | reset_remaining | is_greg | use_cached
+    bad_occ = (hits <= 0) | reset_remaining | is_greg
     grp_bad = np.bincount(
         inv, weights=bad_occ.astype(np.float64), minlength=nb
     ) > 0
@@ -1295,7 +1350,7 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
 
 
 def _run_cascade(plan, h, hits, lim, dur, algo, burst,
-                 status, out_lim, remaining, reset, stored):
+                 status, out_lim, remaining, reset, stored, cachedv):
     """Replay each cascade group's occurrences on host, writing their
     responses in place, and build the effective write-back columns.
 
@@ -1303,7 +1358,11 @@ def _run_cascade(plan, h, hits, lim, dur, algo, burst,
     token (algorithms.go:162-195) and leaky (algorithms.go:395-426) share
     the branch lattice over the running remaining, and leaky's float
     fraction is invariant under integer-hit subtraction so the integer
-    `stored` seed suffices.  Two deliberate, documented divergences:
+    `stored` seed suffices.  A read lane answered VERBATIM from a live
+    broadcast row (`cachedv`, the GLOBAL non-owner steady state) copies
+    its response to every occurrence with no write-back — the device
+    mutates nothing on such reads, so each occurrence would read the
+    identical row.  Two deliberate, documented divergences:
     the table's sticky Status field holds the write-back's value rather
     than the last occurrence's, and a fully-drained leaky group's expiry
     refresh rides an over-limit touch lane."""
@@ -1322,6 +1381,14 @@ def _run_cascade(plan, h, hits, lim, dur, algo, burst,
         hi = np.searchsorted(sorted_inv, g, side="right")
         occ = order[lo:hi]
         fi = occ[0]
+        if cachedv[fi]:
+            # Verbatim broadcast-row serve: share, mutate nothing.
+            rest = occ[1:]
+            status[rest] = status[fi]
+            out_lim[rest] = out_lim[fi]
+            remaining[rest] = remaining[fi]
+            reset[rest] = reset[fi]
+            continue
         lim0 = int(lim[fi])
         algo0 = int(algo[fi])
         reset0 = int(reset[fi])
