@@ -150,7 +150,7 @@ class SketchBackend:
         # A window limit beyond 2^31-1 is outside the tier's design
         # envelope anyway — the clamp only changes such configs.
         i32max = np.int64(2**31 - 1)
-        limits = np.minimum(limits, i32max)
+        limits = np.clip(limits, -i32max, i32max)
         hits = np.clip(hits, -i32max, i32max)
         B = self.batch
         k = 1

@@ -104,6 +104,17 @@ class Cluster:
         async def shutdown() -> None:
             for d in self.daemons:
                 await d.close()
+            # Cancel anything a daemon left behind (a coalescer or
+            # batcher task parked on queue.get) BEFORE the loop closes —
+            # a pending queue getter GC'd after close raises an
+            # unraisable "Event loop is closed" from its callback.
+            rest = [
+                t for t in asyncio.all_tasks()
+                if t is not asyncio.current_task()
+            ]
+            for t in rest:
+                t.cancel()
+            await asyncio.gather(*rest, return_exceptions=True)
 
         self.run(shutdown(), timeout=120.0)
         self._loop.call_soon_threadsafe(self._loop.stop)

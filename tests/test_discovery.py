@@ -16,7 +16,7 @@ DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_dns_pool_resolves_localhost():

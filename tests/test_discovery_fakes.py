@@ -20,7 +20,7 @@ NS = types.SimpleNamespace
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 # --------------------------------------------------------------------------

@@ -18,7 +18,7 @@ from gubernator_tpu.runtime.service import GlobalManager
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 import grpc

@@ -8,7 +8,7 @@ from gubernator_tpu.discovery.gossip import GossipPool
 
 
 def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 async def until(cond, timeout=10.0, interval=0.05):

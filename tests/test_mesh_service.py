@@ -21,7 +21,7 @@ MESH_DEV = DeviceConfig(
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_service_on_mesh_backend():

@@ -11,7 +11,7 @@ DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_named_limits_route_to_sketch():

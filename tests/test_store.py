@@ -24,7 +24,7 @@ DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
 
 
 def run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_loader_load_save_once():

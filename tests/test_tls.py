@@ -113,7 +113,7 @@ def test_tls_cluster_forwarding():
                 await d.close()
             return owners
 
-    owners = asyncio.new_event_loop().run_until_complete(scenario())
+    owners = asyncio.run(scenario())
     assert len(owners) == 2, f"expected both peers serving, got {owners}"
 
 
@@ -182,6 +182,6 @@ def test_https_gateway_client_auth():
         ("verify-if-given", False, True),
         ("verify-if-given", True, True),
     ]:
-        asyncio.new_event_loop().run_until_complete(
+        asyncio.run(
             scenario(client_auth, with_cert, expect_ok)
         )
