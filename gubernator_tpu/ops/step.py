@@ -499,6 +499,50 @@ def probe_batch_impl(
 probe_batch = jax.jit(probe_batch_impl, static_argnames=("ways",))
 
 
+# Row order of gather_rows' packed int output (remaining_f travels as a
+# separate float64 array: TPU's X64-emulation pass cannot rewrite an s64
+# bitcast-convert, so the float is NOT bit-packed into the int stack).
+GATHER_ROW_FIELDS = (
+    "found", "kind", "algo", "limit", "duration", "remaining",
+    "t0", "status", "burst", "expire_at",
+)
+
+
+def gather_rows_impl(
+    table: SlotTable,
+    h: jax.Array,
+    now: jax.Array,
+    ways: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Columnar row read-back: probe + gather every CacheItem field for a
+    hash batch as (int64[10, B] in GATHER_ROW_FIELDS order,
+    float64[B] remaining_f) — two buffers fetched in one sync where
+    per-field reads would cost a transfer each.  The compiled fast lane's
+    Store.on_change capture (the batched analog of the read the reference
+    does inline at algorithms.go:154-158); h=0 lanes read as not-found."""
+    found, slot = probe_batch_impl(table, h, now, ways=ways)
+
+    def g(arr):
+        return arr[slot]
+
+    packed = jnp.stack([
+        found.astype(jnp.int64),
+        g(table.kind).astype(jnp.int64),
+        g(table.algo).astype(jnp.int64),
+        g(table.limit),
+        g(table.duration),
+        g(table.remaining),
+        g(table.t0),
+        g(table.status).astype(jnp.int64),
+        g(table.burst),
+        g(table.expire_at),
+    ])
+    return packed, g(table.remaining_f)
+
+
+gather_rows = jax.jit(gather_rows_impl, static_argnames=("ways",))
+
+
 class CachedRows(NamedTuple):
     """A batch of owner-broadcast statuses (UpdatePeerGlobal rows,
     peers.proto:52-56): key fingerprint + the authoritative RateLimitResp."""

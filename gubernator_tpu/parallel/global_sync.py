@@ -286,10 +286,11 @@ class GlobalEngine:
         # Store (a persisted GLOBAL bucket must survive a restart instead of
         # resetting to full remaining until the first broadcast read-back).
         if self.b._keymap is not None:
-            for j, r in enumerate(agg_reqs):
-                if j not in packed.errors:
-                    k = r.hash_key()
-                    self.b._keymap[key_hash64(k)] = k
+            with self.b._keymap_lock:
+                for j, r in enumerate(agg_reqs):
+                    if j not in packed.errors:
+                        k = r.hash_key()
+                        self.b._keymap[key_hash64(k)] = k
             self.b._maybe_prune_keymap()
         if self.b.store is not None:
             # Lock order everywhere: auth (backend) before cache (self).
@@ -343,10 +344,26 @@ class GlobalEngine:
         lock (merges pipeline) and calls sync() itself when want_sync —
         matching check()'s after-lock sync call.
 
-        Only valid when no Store/keymap is attached (the fast lane's
-        eligibility gate): the object path's seeding hooks are skipped
-        here."""
-        now = np.int64(self.clock.millisecond_now())
+        Persistence hooks run like check()'s: keymap registration and
+        Store.get seeding for never-seen keys (write-through itself
+        happens at sync(), the engine's store tier)."""
+        from gubernator_tpu.core.hashing import key_hash64
+
+        now_ms = self.clock.millisecond_now()
+        if self.b._keymap is not None:
+            with self.b._keymap_lock:
+                for req, _h, _s in pend_items:
+                    k = req.hash_key()
+                    self.b._keymap[key_hash64(k)] = k
+            self.b._maybe_prune_keymap()
+        if self.b.store is not None and pend_items:
+            uniq: Dict[str, RateLimitReq] = {}
+            for req, _h, _s in pend_items:
+                uniq.setdefault(req.hash_key(), req)
+            # Lock order everywhere: auth (backend) before cache (self).
+            with self.b._lock, self._lock:
+                self._seed_uniq_from_store(uniq, now_ms)
+        now = np.int64(now_ms)
         with self._lock:
             resps = []
             for db in rounds:
@@ -378,15 +395,21 @@ class GlobalEngine:
         where sync applies hits, the s.Get of algorithms.go:45-51) and the
         cache table (arrival-routed, so pre-sync serving reflects persisted
         state, not a fresh bucket).  Caller holds b._lock then self._lock."""
-        from gubernator_tpu.runtime.store import item_to_row_fields
-
         uniq: Dict[str, RateLimitReq] = {}
         for j, r in enumerate(agg_reqs):
             if j not in packed.errors:
                 uniq.setdefault(r.hash_key(), r)
-        if not uniq:
-            return
+        if uniq:
+            self._seed_uniq_from_store(uniq, now_ms)
+
+    def _seed_uniq_from_store(
+        self, uniq: Dict[str, "RateLimitReq"], now_ms: int
+    ) -> None:
+        """_seed_from_store_engine body over a per-unique-key request dict
+        (shared by check() and the fast lane's serve_packed).  Caller
+        holds b._lock then self._lock."""
         from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.runtime.store import item_to_row_fields
 
         keys = list(uniq)
         hashes = [key_hash64(k) for k in keys]

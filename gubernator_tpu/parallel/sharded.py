@@ -182,6 +182,27 @@ def make_sharded_probe(mesh, ways: int):
     return jax.jit(sharded)
 
 
+def make_sharded_gather(mesh, ways: int):
+    """Sharded columnar row read-back: (int64[n, 10, B] packed CacheItem
+    fields in ops/step.GATHER_ROW_FIELDS order, float64[n, B]
+    remaining_f) for a shard-routed hash grid — one sync where per-field
+    fancy-index reads would cost a transfer each (the mesh analog of
+    ops/step.gather_rows; the fast lane's Store.on_change capture)."""
+    from gubernator_tpu.ops.step import gather_rows_impl
+
+    def _local(table: SlotTable, h, now):
+        packed, rf = gather_rows_impl(table, h[0], now, ways=ways)
+        return packed[None], rf[None]
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded)
+
+
 def drain_to_grids(per_shard: List[list], B: int, make_grid, fill_lane):
     """Drain per-shard row lists into consecutive [n, B] grids (overflow
     chunks into extra grids).  `fill_lane(grid, shard, lane, row)` writes
@@ -250,6 +271,7 @@ class MeshBackend(PersistenceHost):
             self.mesh, cfg.ways, load_rows_impl, BucketRows
         )
         self._probe_sharded = make_sharded_probe(self.mesh, cfg.ways)
+        self._gather_sharded = make_sharded_gather(self.mesh, cfg.ways)
         self.checks = 0
         self.over_limit = 0
         self.not_persisted = 0
@@ -284,10 +306,11 @@ class MeshBackend(PersistenceHost):
         now_ms = self.clock.millisecond_now()
         now = np.int64(now_ms)
         if self._keymap is not None:
-            for i, r in enumerate(reqs):
-                if i not in packed.errors:
-                    k = r.hash_key()
-                    self._keymap[key_hash64(k)] = k
+            with self._keymap_lock:
+                for i, r in enumerate(reqs):
+                    if i not in packed.errors:
+                        k = r.hash_key()
+                        self._keymap[key_hash64(k)] = k
             self._maybe_prune_keymap()
 
         round_resps = []
@@ -399,6 +422,7 @@ class MeshBackend(PersistenceHost):
                 self._bsharding,
             )
             self._probe_sharded(self.table, zeros, now)
+            self._gather_sharded(self.table, zeros, now)
             self.table = self._cached_store(
                 self.table,
                 CachedRows(*[
@@ -429,8 +453,9 @@ class MeshBackend(PersistenceHost):
         n, B = self.cfg.num_shards, self.cfg.batch_size
         now = np.int64(self.clock.millisecond_now())
         if self._keymap is not None:
-            for key, *_ in rows:
-                self._keymap[key_hash64(key)] = key
+            with self._keymap_lock:
+                for key, *_ in rows:
+                    self._keymap[key_hash64(key)] = key
         per_shard: List[list] = [[] for _ in range(n)]
         for row in rows:
             h = key_hash64(row[0])
@@ -536,6 +561,59 @@ class MeshBackend(PersistenceHost):
     def _found_mask(self, keys, hashes, now: int) -> np.ndarray:
         found, _ = self._probe_grid(keys, hashes, now)
         return found
+
+    def _gather_rows_dispatch(self, h64: np.ndarray, now: int):
+        """Dispatch shard-routed columnar row gathers for int64
+        fingerprints (lock held).  Returns an opaque token for
+        `_gather_rows_finish`: the dispatched reads are pinned to this
+        table version (jax arrays are immutable), so the caller may
+        release the lock before fetching."""
+        n, B = self.cfg.num_shards, self.cfg.batch_size
+        sh = shard_of_hash(h64, n)
+        per_shard: List[list] = [[] for _ in range(n)]
+        for j, h in enumerate(h64):
+            per_shard[int(sh[j])].append((j, int(h)))
+
+        def make_grid():
+            return [
+                np.zeros((n, B), dtype=np.int64),
+                np.full((n, B), -1, dtype=np.int64),
+            ]
+
+        def fill(grid, s, lane, row):
+            j, h = row
+            grid[0][s, lane] = h
+            grid[1][s, lane] = j
+
+        token = []
+        for hv, jv in drain_to_grids(per_shard, B, make_grid, fill):
+            token.append((
+                self._gather_sharded(
+                    self.table,
+                    jax.device_put(hv, self._bsharding),
+                    np.int64(now),
+                ),
+                jv,
+            ))
+        return token
+
+    def _gather_rows_finish(self, token, m: int):
+        """Fetch dispatched row gathers into (int64[10, m] columns in
+        ops/step.GATHER_ROW_FIELDS order, float64[m] remaining_f), in
+        fingerprint order."""
+        from gubernator_tpu.ops.step import GATHER_ROW_FIELDS
+
+        out = np.zeros((len(GATHER_ROW_FIELDS), m), dtype=np.int64)
+        rf = np.zeros(m, dtype=np.float64)
+        for (d, drf), jv in token:
+            a = np.asarray(d)    # [n_shards, 10, B]
+            f = np.asarray(drf)  # [n_shards, B]
+            for s in range(a.shape[0]):
+                sel = jv[s] >= 0
+                if sel.any():
+                    out[:, jv[s][sel]] = a[s][:, sel]
+                    rf[jv[s][sel]] = f[s][sel]
+        return out, rf
 
     def _bulk_upsert(
         self, rows: List[dict], hashes: List[int], now: int

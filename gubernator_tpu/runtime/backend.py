@@ -37,6 +37,7 @@ from gubernator_tpu.ops.step import (
     BucketRows,
     CachedRows,
     apply_batch_packed_q,
+    gather_rows,
     load_rows,
     probe_batch,
     store_cached_rows,
@@ -97,7 +98,10 @@ class PersistenceHost:
         """Bound the fingerprint->key map: the table holds at most num_slots
         live rows, so once the map is 4x that, drop fingerprints no longer
         resident (evicted/expired keys would otherwise accumulate forever).
-        """
+        The rebuild holds `_keymap_lock` — the object path's executor
+        thread, the fast-lane pool, and the engine lane all write the map
+        concurrently, and an unlocked rebuild would either crash on a
+        concurrent insert or silently drop it."""
         assert self._keymap is not None
         if len(self._keymap) <= max(4 * self.cfg.num_slots, 65_536):
             return
@@ -105,16 +109,15 @@ class PersistenceHost:
             resident = set(
                 np.asarray(self.table.key).view(np.uint64).tolist()
             )
-        self._keymap = {
-            fp: k for fp, k in self._keymap.items() if fp in resident
-        }
+        with self._keymap_lock:
+            self._keymap = {
+                fp: k for fp, k in self._keymap.items() if fp in resident
+            }
 
     def _seed_from_store(self, reqs, packed, now: int) -> None:
         """Consult Store.get for batch keys not resident on device and bulk
         upsert the hits (the batched analog of algorithms.go:45-51).
         Caller holds `_lock`."""
-        from gubernator_tpu.runtime.store import item_to_row_fields
-
         uniq: Dict[str, RateLimitReq] = {}
         for i, r in enumerate(reqs):
             if i not in packed.errors:
@@ -123,13 +126,22 @@ class PersistenceHost:
         if not keys:
             return
         hashes = [key_hash64(k) for k in keys]
+        self._seed_missing(keys, hashes, [uniq[k] for k in keys], now)
+
+    def _seed_missing(self, keys, hashes, reqs, now: int) -> None:
+        """Seeding core shared by the object path and the fast lane's
+        columnar drains: one residency probe over `hashes` (unsigned),
+        Store.get only for the misses, one bulk upsert.  Caller holds
+        `_lock`."""
+        from gubernator_tpu.runtime.store import item_to_row_fields
+
         found = self._found_mask(keys, hashes, now)
         rows: List[dict] = []
         row_hashes: List[int] = []
-        for k, h, f in zip(keys, hashes, found):
+        for h, r, f in zip(hashes, reqs, found):
             if f:
                 continue
-            item = self.store.get(uniq[k])
+            item = self.store.get(r)
             if item is None or item.is_expired(now):
                 continue
             rows.append(item_to_row_fields(item))
@@ -138,10 +150,14 @@ class PersistenceHost:
             self._bulk_upsert(rows, row_hashes, now)
 
     def _init_write_through(self) -> None:
-        """Write-through delivery ordering state (backend __init__)."""
+        """Write-through delivery ordering + keymap-writer state (backend
+        __init__)."""
         self._wt_seq = 0
         self._wt_next = 0
         self._wt_cond = threading.Condition()
+        # Guards every _keymap mutation: the step executor, the fast-lane
+        # pool, and the engine lane write it from different threads.
+        self._keymap_lock = threading.Lock()
 
     def _wt_ticket(self) -> int:
         """Next write-through delivery ticket (caller holds `_lock`).
@@ -215,7 +231,8 @@ class PersistenceHost:
         for item in items:
             h = key_hash64(item.key)
             if self._keymap is not None:
-                self._keymap[h] = item.key
+                with self._keymap_lock:
+                    self._keymap[h] = item.key
             rows.append(item_to_row_fields(item))
             hashes.append(h)
             n += 1
@@ -298,6 +315,9 @@ class DeviceBackend(PersistenceHost):
         self._store_cached = functools.partial(
             store_cached_rows, ways=self.cfg.ways
         )
+        self._gather_rows = functools.partial(
+            gather_rows, ways=self.cfg.ways
+        )
         self.store = store
         # fingerprint -> hash-key string, maintained when persistence needs
         # to reconstruct key strings from device rows (save path).
@@ -346,10 +366,11 @@ class DeviceBackend(PersistenceHost):
         )
         now = self.clock.millisecond_now()
         if self._keymap is not None:
-            for i, r in enumerate(reqs):
-                if i not in packed.errors:
-                    k = r.hash_key()
-                    self._keymap[key_hash64(k)] = k
+            with self._keymap_lock:
+                for i, r in enumerate(reqs):
+                    if i not in packed.errors:
+                        k = r.hash_key()
+                        self._keymap[key_hash64(k)] = k
             self._maybe_prune_keymap()
         round_resps = []
         captured = None
@@ -398,11 +419,14 @@ class DeviceBackend(PersistenceHost):
     ) -> List[Dict[str, np.ndarray]]:
         """Columnar hot path: apply pre-packed [B] DeviceBatch rounds with
         no per-request Python anywhere (the compiled fast lane,
-        runtime/fastpath.py).  Persistence hooks are NOT run — the fast
-        lane is only taken when no Store/Loader is attached.  Returns host
-        response dicts per round; with add_tally, tallies update
-        vectorized (the fast lane passes False and counts per REQUEST —
-        cascade occurrences share device lanes)."""
+        runtime/fastpath.py).  Persistence hooks are NOT run here — a
+        store-attached drain runs them itself around
+        _dispatch_rounds_locked (fastpath._process: seed inside the lock,
+        capture dispatched inside, delivered outside); this entry serves
+        the storeless plain merge.  Returns host response dicts per round;
+        with add_tally, tallies update vectorized (the fast lane passes
+        False and counts per REQUEST — cascade occurrences share device
+        lanes)."""
         with self._lock:
             round_resps = self._dispatch_rounds_locked(rounds)
         host = packed_rounds_to_host(round_resps)
@@ -433,16 +457,53 @@ class DeviceBackend(PersistenceHost):
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
         """found-mask for a host hash vector, probing in fixed batch_size
         chunks so the jitted probe never sees a new shape (the fixed-shape
-        rule, core/config.py DeviceConfig)."""
+        rule, core/config.py DeviceConfig).  All chunks dispatch before the
+        first fetch — one round-trip of latency however many chunks."""
         B = self.cfg.batch_size
-        out = np.zeros(len(hashes), dtype=bool)
+        devs = []
         for lo in range(0, len(hashes), B):
             chunk = hashes[lo:lo + B]
             padded = np.zeros(B, dtype=np.int64)
             padded[: len(chunk)] = chunk
-            found, _ = self._probe(self.table, padded, np.int64(now))
-            out[lo:lo + len(chunk)] = np.asarray(found)[: len(chunk)]
+            devs.append(self._probe(self.table, padded, np.int64(now))[0])
+        out = np.zeros(len(hashes), dtype=bool)
+        for i, d in enumerate(devs):
+            lo = i * B
+            out[lo:lo + B] = np.asarray(d)[: len(hashes) - lo]
         return out
+
+    def _gather_rows_dispatch(self, h64: np.ndarray, now: int):
+        """Dispatch columnar row gathers for int64 fingerprints (lock
+        held).  Returns an opaque token for `_gather_rows_finish`: the
+        dispatched reads are pinned to this table version (jax arrays are
+        immutable), so the caller may release the lock before fetching."""
+        B = self.cfg.batch_size
+        token = []
+        for lo in range(0, len(h64), B):
+            chunk = h64[lo:lo + B]
+            padded = np.zeros(B, dtype=np.int64)
+            padded[: len(chunk)] = chunk
+            token.append(
+                self._gather_rows(self.table, padded, np.int64(now))
+            )
+        return token
+
+    def _gather_rows_finish(self, token, m: int):
+        """Fetch dispatched row gathers into (int64[10, m] columns in
+        ops/step.GATHER_ROW_FIELDS order, float64[m] remaining_f), in
+        fingerprint order."""
+        from gubernator_tpu.ops.step import GATHER_ROW_FIELDS
+
+        if not token:
+            return (
+                np.zeros((len(GATHER_ROW_FIELDS), 0), dtype=np.int64),
+                np.zeros(0),
+            )
+        packed = np.concatenate(
+            [np.asarray(d) for d, _rf in token], axis=1
+        )[:, :m]
+        rf = np.concatenate([np.asarray(r) for _d, r in token])[:m]
+        return packed, rf
 
     def warmup(self) -> None:
         """Compile the hot-path executables with a synthetic batch that
@@ -471,8 +532,14 @@ class DeviceBackend(PersistenceHost):
                 self.table, resp = self._step_packed_q(
                     self.table, pack_batch_q(db)[:, :t], now
                 )
-            # Fixed-shape probe executable (store seeding / bulk reads).
+            # Fixed-shape probe + row-gather executables (store seeding /
+            # write-through capture / bulk reads).
             self._probe(
+                self.table,
+                np.zeros(self.cfg.batch_size, dtype=np.int64),
+                now,
+            )
+            self._gather_rows(
                 self.table,
                 np.zeros(self.cfg.batch_size, dtype=np.int64),
                 now,
@@ -577,8 +644,9 @@ class DeviceBackend(PersistenceHost):
         if not rows:
             return
         if self._keymap is not None:
-            for key, *_ in rows:
-                self._keymap[key_hash64(key)] = key
+            with self._keymap_lock:
+                for key, *_ in rows:
+                    self._keymap[key_hash64(key)] = key
         B = self.cfg.batch_size
         now = self.clock.millisecond_now()
         with self._lock:

@@ -26,7 +26,14 @@ runtime/service.py) by concatenating their columns before packing.
 Eligibility — anything else falls back to the object path, which remains
 the semantic reference:
   - native library loadable;
-  - no Store / Loader attached (their hooks are per-key);
+  - a Store / Loader attached stays ON the lane: each drain bulk-probes
+    residency, calls Store.get only for the misses, and captures
+    write-through rows with ONE packed device gather (ticketed
+    on_change delivery, like the object path's batch-boundary fix).
+    The SPI itself takes Python objects, so the lane decodes one
+    request per UNIQUE key per drain — the only per-key host cost;
+    on_change fires once per unique key per DRAIN (coalesced RPCs
+    share one delivery; final store state matches the object path);
   - GLOBAL is served HERE — use_cached lanes for non-owned reads,
     queued hits/updates for the managers, and node-owned lanes on a
     mesh service ingesting into the collective GlobalEngine's
@@ -217,12 +224,10 @@ class FastPath:
 
     # -- eligibility -----------------------------------------------------
     def _eligible(self) -> bool:
-        b = self.s.backend
-        return (
-            native.available()
-            and b.store is None
-            and b._keymap is None
-        )
+        # Persistence (Store/Loader/keymap) is served ON the lane:
+        # seeding/capture batch columnarly per drain (_process), so a
+        # store-attached deployment keeps the compiled path.
+        return native.available()
 
     def _sketch_hashes(self) -> np.ndarray:
         """XXH64 fingerprints of the sketch-tier names (route key for the
@@ -291,6 +296,14 @@ class FastPath:
                 "Requests.RateLimits list too large; max size is '%d'"
                 % MAX_BATCH_SIZE,
             )
+        if not peer_rpc and n and cols.err.any():
+            # Metric parity with the object path's client-side validation
+            # rejections (gubernator.go:229, 235).
+            n_inv = int(((cols.err == 1) | (cols.err == 2)).sum())
+            if n_inv:
+                self.s.metrics.check_error_counter.labels(
+                    error="Invalid request"
+                ).inc(n_inv)
         sk: Optional[np.ndarray] = None
         if self.s.sketch_backend is not None and n:
             sk = np.isin(cols.name_hash, self._sketch_hashes()) & (
@@ -378,11 +391,14 @@ class FastPath:
         return out
 
     async def _serve_cols(
-        self, cols, is_greg, ge, gd, use_cached=None
+        self, payload, cols, is_greg, ge, gd, use_cached=None
     ) -> Tuple[np.ndarray, ...]:
         """Submit columns to the coalescing batcher; returns the four
-        response arrays (status, limit, remaining, reset_time)."""
+        response arrays (status, limit, remaining, reset_time).  `payload`
+        is the raw wire bytes the columns were spliced from — the
+        persistence SPI decodes per-unique-key requests from it."""
         return await self._mach.do(_Entry(
+            payload=payload,
             cols=cols,
             is_greg=is_greg,
             greg_expire=ge,
@@ -442,23 +458,26 @@ class FastPath:
             mgr.queue_hit(dc_replace(req, hits=total))
 
     def _queue_global_updates(self, payload, cols, is_global,
-                              owned=None) -> None:
-        """Queue owner-side broadcast updates for GLOBAL lanes — ERRORED
-        lanes included: the reference QueueUpdates before the algorithm
-        runs (gubernator.go:617-619), so with last-write-wins per key an
-        errored occurrence can cancel a valid one's pending broadcast.
-        The fast lane reproduces that exactly: the LAST arrival per key
-        wins, valid or not.
+                              owned=None, peer_rpc=False) -> None:
+        """Queue owner-side broadcast updates for GLOBAL lanes — GREGORIAN-
+        errored lanes included: the reference QueueUpdates before the
+        algorithm runs (gubernator.go:617-619), so with last-write-wins
+        per key an errored occurrence can cancel a valid one's pending
+        broadcast.  The fast lane reproduces that exactly: the LAST
+        arrival per key wins, valid or not.  VALIDATION-errored lanes
+        (empty name/key) queue only on the peer RPC: the client RPC
+        rejects them before routing (gubernator.go:228-237) so they never
+        reach the algorithm, while the peer RPC validates owner-side
+        AFTER QueueUpdate.
 
         `owned` (routed path) masks node-owned lanes.  Which branch an
         errored lane takes depends on where its error was detected:
-        validation errors (empty name/key) have hash 0 from the parser
-        and route through the decode branch below, with ownership
-        decided from the decoded key string like the object path's
-        routing; Gregorian errors on the ROUTED path keep their true
-        hash in `cols` (only serve_local's subset copy was zeroed), so
-        they group with the valid lanes — same last-write-wins outcome
-        either way."""
+        validation errors have hash 0 from the parser and route through
+        the decode branch below, with ownership decided from the decoded
+        key string like the object path's routing; Gregorian errors on
+        the ROUTED path keep their true hash in `cols` (only
+        serve_local's subset copy was zeroed), so they group with the
+        valid lanes — same last-write-wins outcome either way."""
         idx = np.flatnonzero(is_global)
         if not len(idx):
             return
@@ -472,6 +491,10 @@ class FastPath:
         ):
             best[req.hash_key()] = (int(group[-1]), req)
         err_lanes = idx[hv == 0]
+        if len(err_lanes) and not peer_rpc:
+            # Client path: only Gregorian failures reached the algorithm;
+            # validation errors were rejected before routing.
+            err_lanes = err_lanes[cols.err[err_lanes] == _ERR_GREG]
         if len(err_lanes):
             from gubernator_tpu.runtime.service import PoolEmptyError
 
@@ -523,7 +546,7 @@ class FastPath:
         no_eng = eng is None or not eng.any()
         if no_sk and no_eng:
             return await self._serve_cols(
-                cols, is_greg, ge, gd, use_cached=use_cached
+                payload, cols, is_greg, ge, gd, use_cached=use_cached
             )
         n = cols.n
         sk_m = sk if sk is not None else np.zeros(n, dtype=bool)
@@ -566,7 +589,7 @@ class FastPath:
         async def run_exact() -> None:
             sub = cols.subset(ex_idx)
             st, lm, rem, rst = await self._serve_cols(
-                sub, is_greg[ex_idx], ge[ex_idx], gd[ex_idx],
+                payload, sub, is_greg[ex_idx], ge[ex_idx], gd[ex_idx],
                 use_cached=(
                     use_cached[ex_idx] if use_cached is not None else None
                 ),
@@ -597,6 +620,7 @@ class FastPath:
         key keeps separate lanes, which assign_rounds places in later
         rounds — so a drain of N entries is semantically N sequential
         engine calls, amortized into one round-trip."""
+        from gubernator_tpu.parallel.global_sync import _ARRIVAL_SHIFT
         from gubernator_tpu.parallel.sharded import (
             packed_grid_rounds_to_host,
         )
@@ -608,7 +632,7 @@ class FastPath:
         engine = self.s.global_engine
         cfg = self.s.backend.cfg
         n_shards, B = cfg.num_shards, cfg.batch_size
-        shift = np.uint64(44)  # _ARRIVAL_SHIFT; vectorized arrival_dev
+        shift = np.uint64(_ARRIVAL_SHIFT)  # vectorized arrival_dev
 
         per = []
         for e in entries:
@@ -749,7 +773,9 @@ class FastPath:
             # With a collective engine, GLOBAL lanes (errored included)
             # belong to the engine path on the object flow — the RPC
             # update manager is never consulted.
-            self._queue_global_updates(payload, cols, is_global)
+            self._queue_global_updates(
+                payload, cols, is_global, peer_rpc=peer_rpc
+            )
         mr = (cols.behavior & _MULTI_REGION) != 0
         if mr.any():
             self._queue_multiregion(
@@ -1001,6 +1027,77 @@ class FastPath:
             b"".join(errs), err_off, b"".join(metas), meta_off,
         )
 
+    # -- persistence SPI on the lane -------------------------------------
+    def _persist_decode(self, entries) -> Dict[int, list]:
+        """Per-unique-key request decodes for the persistence SPI
+        (Store.get / Store.on_change / the Loader keymap take Python
+        objects — the one per-KEY host cost the lane pays with
+        persistence attached; everything else stays columnar).
+
+        Returns fp(int64) -> [hash_key_str, first_req, capture_req],
+        in first-arrival entry order.  `capture_req` is None when every
+        occurrence is a GLOBAL cached read (use_cached) — such keys are
+        excluded from write-through like _capture_write_through."""
+        uniq: Dict[int, list] = {}
+        for e in entries:
+            valid = np.flatnonzero(e.cols.hash != 0)
+            for req, group in self._decode_unique(e.payload, e.cols, valid):
+                fp = int(e.cols.hash[group[0]])
+                uc = e.use_cached[group]
+                cap = None
+                if not uc.all():
+                    cap = req if not uc[0] else self._decode_req(
+                        e.payload, e.cols, int(group[~uc][0])
+                    )
+                cur = uniq.get(fp)
+                if cur is None:
+                    uniq[fp] = [req.hash_key(), req, cap]
+                elif cur[2] is None and cap is not None:
+                    cur[2] = cap
+        return uniq
+
+    def _seed_store_locked(self, backend, uniq, now: int) -> None:
+        """Bulk Store.get seeding for a drain's unique keys (backend lock
+        held) via the shared probe-miss/get/upsert core
+        (PersistenceHost._seed_missing; algorithms.go:45-51 batched)."""
+        backend._seed_missing(
+            [v[0] for v in uniq.values()],
+            [int(np.int64(fp).view(np.uint64)) for fp in uniq],
+            [v[1] for v in uniq.values()],
+            now,
+        )
+
+    def _build_captured(self, backend, uniq, cap_fps, token) -> list:
+        """CacheItems from the packed gather columns (GATHER_ROW_FIELDS
+        order) — misses and KIND_CACHED_RESP rows are skipped exactly like
+        _read_items_locked."""
+        from gubernator_tpu.core.types import Algorithm, CacheItem, Status
+        from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
+        a, rf = backend._gather_rows_finish(token, len(cap_fps))
+        out = []
+        for j, fp in enumerate(cap_fps):
+            if not a[0, j] or a[1, j] == KIND_CACHED_RESP:
+                continue
+            key, _req, cap_req = uniq[int(fp)]
+            algo = Algorithm(int(a[2, j]))
+            remaining = (
+                float(rf[j]) if algo == Algorithm.LEAKY_BUCKET
+                else int(a[5, j])
+            )
+            out.append((cap_req, CacheItem(
+                key=key,
+                algorithm=algo,
+                expire_at=int(a[9, j]),
+                limit=int(a[3, j]),
+                duration=int(a[4, j]),
+                remaining=remaining,
+                created_at=int(a[6, j]),
+                status=Status(int(a[7, j])),
+                burst=int(a[8, j]),
+            )))
+        return out
+
     # -- merge processing (runs on _pool threads via _Coalescer) ---------
     def _sketch_process(
         self, entries: Sequence["_SketchEntry"]
@@ -1075,6 +1172,19 @@ class FastPath:
         )
 
         backend = self.s.backend
+        store = backend.store
+        uniq = (
+            self._persist_decode(entries)
+            if (store is not None or backend._keymap is not None)
+            else None
+        )
+        if uniq and backend._keymap is not None:
+            with backend._keymap_lock:
+                km = backend._keymap
+                for fp, (key, _r, _c) in uniq.items():
+                    km[int(np.int64(fp).view(np.uint64))] = key
+            backend._maybe_prune_keymap()
+        do_store = store is not None and bool(uniq)
         if plan is None:
             h_mach, hits_mach = h, hits
         else:
@@ -1130,7 +1240,7 @@ class FastPath:
                 stored[sel] = hr["stored"][idx]
                 cachedv[sel] = hr["cached"][idx]
 
-        if plan is None:
+        if plan is None and not do_store:
             # Plain merge: dispatch under the backend lock, sync outside
             # — arrivals keep accumulating into the NEXT maximal merge
             # while this one's response syncs (and at fastpath_inflight
@@ -1145,38 +1255,79 @@ class FastPath:
             # single-writer discipline as every other mutation path).  The
             # write-back itself needs no response sync: the replay already
             # produced every response, and dispatch order serializes it.
+            #
+            # Store drains take this branch too: seeding's probe must be
+            # read INSIDE the lock (a concurrent insert between probe and
+            # upsert would be overwritten by stale store state), and the
+            # write-through capture must be DISPATCHED inside it (pinning
+            # the post-step table version) — but the capture's fetch and
+            # on_change delivery happen outside, in ticket order.
+            cap_token = wt_seq = None
             with backend._lock:
-                host = to_host(backend._dispatch_rounds_locked(rounds))
-                gather(host)
-                wb = _run_cascade(
-                    plan, h, hits, lim, dur, algo, burst,
-                    status, out_lim, remaining, reset, stored, cachedv,
-                )
-                if wb is not None:
-                    wb_h, wb_hits, wb_lim, wb_dur, wb_algo, wb_burst = wb
-                    wb_sh = (
-                        shard_of_hash(wb_h, n_shards).astype(np.int32)
-                        if n_shards > 1 else None
+                if do_store:
+                    self._seed_store_locked(
+                        backend, uniq, backend.clock.millisecond_now()
                     )
-                    wrnd, wlane, wn = native.assign_rounds(
-                        wb_h, wb_sh, n_shards, B
+                resps = backend._dispatch_rounds_locked(rounds)
+                if plan is not None:
+                    host = to_host(resps)
+                    gather(host)
+                    wb = _run_cascade(
+                        plan, h, hits, lim, dur, algo, burst,
+                        status, out_lim, remaining, reset, stored, cachedv,
                     )
-                    m = len(wb_h)
-                    wvals = dict(
-                        key_hash=wb_h, hits=wb_hits, limit=wb_lim,
-                        duration=wb_dur, algo=wb_algo, burst=wb_burst,
-                        reset_remaining=np.zeros(m, dtype=bool),
-                        is_greg=np.zeros(m, dtype=bool),
-                        greg_expire=np.zeros(m, dtype=np.int64),
-                        greg_duration=np.zeros(m, dtype=np.int64),
+                    if wb is not None:
+                        (wb_h, wb_hits, wb_lim, wb_dur, wb_algo,
+                         wb_burst) = wb
+                        wb_sh = (
+                            shard_of_hash(wb_h, n_shards).astype(np.int32)
+                            if n_shards > 1 else None
+                        )
+                        wrnd, wlane, wn = native.assign_rounds(
+                            wb_h, wb_sh, n_shards, B
+                        )
+                        m = len(wb_h)
+                        wvals = dict(
+                            key_hash=wb_h, hits=wb_hits, limit=wb_lim,
+                            duration=wb_dur, algo=wb_algo, burst=wb_burst,
+                            reset_remaining=np.zeros(m, dtype=bool),
+                            is_greg=np.zeros(m, dtype=bool),
+                            greg_expire=np.zeros(m, dtype=np.int64),
+                            greg_duration=np.zeros(m, dtype=np.int64),
+                        )
+                        wb_rounds, _, _ = _build_rounds(
+                            wvals, wrnd, wlane,
+                            wb_sh if wb_sh is not None
+                            else np.zeros(m, dtype=np.int32),
+                            wn, n_shards, B,
+                        )
+                        backend._dispatch_rounds_locked(wb_rounds)
+                if do_store:
+                    cap_fps = np.array(
+                        [fp for fp, v in uniq.items() if v[2] is not None],
+                        dtype=np.int64,
                     )
-                    wb_rounds, _, _ = _build_rounds(
-                        wvals, wrnd, wlane,
-                        wb_sh if wb_sh is not None
-                        else np.zeros(m, dtype=np.int32),
-                        wn, n_shards, B,
+                    cap_token = backend._gather_rows_dispatch(
+                        cap_fps, backend.clock.millisecond_now()
                     )
-                    backend._dispatch_rounds_locked(wb_rounds)
+                    wt_seq = backend._wt_ticket()
+            if do_store:
+                captured: list = []
+                try:
+                    if plan is None:
+                        host = to_host(resps)
+                        gather(host)
+                    captured = self._build_captured(
+                        backend, uniq, cap_fps, cap_token
+                    )
+                finally:
+                    # The ticket MUST be redeemed even if any fetch fails
+                    # (the step already happened; a skipped redemption
+                    # wedges every later delivery in cond.wait) — hence
+                    # the response sync sits INSIDE this try as well.
+                    backend._deliver_write_through(captured, wt_seq)
+            # else: plan is not None (the branch condition), so the host
+            # sync already happened inside the lock for the cascade.
 
         # Metric parity: checks/over-limit from the per-REQUEST outputs
         # (cascade occurrences never had their own device lane); cache
@@ -1220,12 +1371,13 @@ class _Entry:
     """Machinery-lane coalescer entry (fut assigned by _Coalescer.do)."""
 
     __slots__ = (
-        "cols", "is_greg", "greg_expire", "greg_duration", "use_cached",
-        "fut",
+        "payload", "cols", "is_greg", "greg_expire", "greg_duration",
+        "use_cached", "fut",
     )
 
-    def __init__(self, cols, is_greg, greg_expire, greg_duration,
+    def __init__(self, payload, cols, is_greg, greg_expire, greg_duration,
                  use_cached):
+        self.payload = payload
         self.cols = cols
         self.is_greg = is_greg
         self.greg_expire = greg_expire
