@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Golden wire-fixture generator (run manually; ARTIFACTS are committed).
+
+Produces byte captures under tests/fixtures/wire/ from the REFERENCE's own
+.proto files (/root/reference/proto, compiled with protoc into a scratch
+module — deliberately NOT this repo's pb2), plus a consistent-hash
+placement table derived from replicated_hash.go:81-118's algorithm with a
+local FNV-1/FNV-1a implementation written from the FNV spec (offset basis
+0xcbf29ce484222325, prime 0x100000001b3).  tests/test_wire_fixtures.py then
+pins this repo's C++ codec, pb2 path, and vnode ring against bytes and
+placements no repo codec produced — drift in any of them breaks the pin.
+
+Usage (requires the reference checkout + protoc + python protobuf):
+
+    mkdir -p /tmp/refpb
+    protoc -I/root/reference/proto \
+        -I$(python -c 'import google.api, os, sys; \
+            sys.stdout.write(os.path.dirname(os.path.dirname(os.path.dirname(google.api.__file__))))') \
+        gubernator.proto peers.proto --python_out=/tmp/refpb
+    python scripts/gen_wire_fixtures.py /tmp/refpb
+"""
+import hashlib
+import json
+import os
+import sys
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures",
+                   "wire")
+
+_MASK = (1 << 64) - 1
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def fnv1_64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = (h * _FNV_PRIME) & _MASK
+        h ^= b
+    return h
+
+
+def fnv1a_64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK
+    return h
+
+
+def placement(peers, keys, hash_fn, replicas=512):
+    """(key -> owner grpc address) exactly as replicated_hash.go computes
+    it: vnode points hash_fn(str(i) + md5hex(addr)), binary search for the
+    first point >= hash_fn(key), wrapping to 0."""
+    points = []
+    for addr in peers:
+        digest = hashlib.md5(addr.encode()).hexdigest()
+        for i in range(replicas):
+            points.append((hash_fn(f"{i}{digest}".encode()), addr))
+    points.sort(key=lambda p: p[0])
+    hashes = [p[0] for p in points]
+    out = {}
+    import bisect
+
+    for k in keys:
+        idx = bisect.bisect_left(hashes, hash_fn(k.encode()))
+        if idx == len(points):
+            idx = 0
+        out[k] = points[idx][1]
+    return out
+
+
+REQS = [
+    dict(name="requests_per_sec", unique_key="account:1234", hits=1,
+         limit=100, duration=60_000, algorithm=0, behavior=0, burst=0),
+    # Varint edges: negative int64 (10-byte varint), int64 max, GLOBAL |
+    # RESET_REMAINING flags.
+    dict(name="a", unique_key="b", hits=-1, limit=(1 << 63) - 1,
+         duration=1, algorithm=1, behavior=10, burst=25),
+    dict(),  # all proto3 defaults -> empty nested message
+    dict(name="café", unique_key="ключ🔑", hits=(1 << 31) - 1,
+         limit=1 << 31, duration=3_600_000, algorithm=0, behavior=0,
+         burst=0),
+    dict(name="over", unique_key="x" * 300, hits=0, limit=5,
+         duration=604_800_000, algorithm=1, behavior=64, burst=5),
+]
+
+RESPS = [
+    dict(status=1, limit=100, remaining=0, reset_time=1_700_000_060_000,
+         error="", metadata={"owner": "10.0.0.1:81"}),
+    dict(status=0, limit=(1 << 63) - 1, remaining=(1 << 62),
+         reset_time=(1 << 53), error="", metadata={}),
+    dict(status=0, limit=0, remaining=0, reset_time=0,
+         error="field 'unique_key' cannot be empty", metadata={}),
+    dict(status=0, limit=20, remaining=19, reset_time=1_700_000_000_123,
+         error="", metadata={"tier": "sketch", "owner": "10.0.0.2:81"}),
+]
+
+UPDATES = [
+    dict(key="rate_check_account:1234",
+         status=dict(status=1, limit=100, remaining=0,
+                     reset_time=1_700_000_060_000, error="", metadata={}),
+         algorithm=1),
+    dict(key="a_b",
+         status=dict(status=0, limit=(1 << 63) - 1, remaining=7,
+                     reset_time=123, error="", metadata={}),
+         algorithm=0),
+]
+
+PEERS = ["10.0.0.1:81", "10.0.0.2:81", "10.0.0.3:81", "10.0.0.4:81"]
+
+KEYS = (
+    ["requests_per_sec_account:1234", "a_b", "café_ключ🔑",
+     "over_" + "x" * 300, "rate_check_account:1234"]
+    + [f"key{i}" for i in range(27)]
+)
+
+
+def main() -> None:
+    sys.path.insert(0, sys.argv[1] if len(sys.argv) > 1 else "/tmp/refpb")
+    import gubernator_pb2 as rpb
+    import peers_pb2 as ppb
+
+    os.makedirs(OUT, exist_ok=True)
+
+    def mkreq(d):
+        return rpb.RateLimitReq(**d)
+
+    def mkresp(d):
+        m = rpb.RateLimitResp(
+            status=d["status"], limit=d["limit"],
+            remaining=d["remaining"], reset_time=d["reset_time"],
+            error=d["error"],
+        )
+        for k, v in d["metadata"].items():
+            m.metadata[k] = v
+        return m
+
+    files = {}
+
+    def emit(fname, msg):
+        data = msg.SerializeToString()
+        with open(os.path.join(OUT, fname), "wb") as f:
+            f.write(data)
+        files[fname] = len(data)
+
+    emit("getratelimits_req.bin",
+         rpb.GetRateLimitsReq(requests=[mkreq(d) for d in REQS]))
+    emit("getratelimits_resp.bin",
+         rpb.GetRateLimitsResp(responses=[mkresp(d) for d in RESPS]))
+    emit("getpeerratelimits_req.bin",
+         ppb.GetPeerRateLimitsReq(requests=[mkreq(d) for d in REQS]))
+    emit("getpeerratelimits_resp.bin",
+         ppb.GetPeerRateLimitsResp(
+             rate_limits=[mkresp(d) for d in RESPS]))
+    emit("updatepeerglobals_req.bin",
+         ppb.UpdatePeerGlobalsReq(globals=[
+             ppb.UpdatePeerGlobal(
+                 key=u["key"], status=mkresp(u["status"]),
+                 algorithm=u["algorithm"],
+             )
+             for u in UPDATES
+         ]))
+
+    manifest = {
+        "note": "generated by scripts/gen_wire_fixtures.py from the "
+                "reference protos; do not regenerate casually — these pin "
+                "wire compatibility",
+        "files": files,
+        "requests": REQS,
+        "responses": RESPS,
+        "updates": UPDATES,
+        "placement": {
+            "peers": PEERS,
+            "replicas": 512,
+            "fnv1": placement(PEERS, KEYS, fnv1_64),
+            "fnv1a": placement(PEERS, KEYS, fnv1a_64),
+        },
+    }
+    with open(os.path.join(OUT, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, ensure_ascii=False, sort_keys=True)
+    print("wrote", OUT, files)
+
+
+if __name__ == "__main__":
+    main()
