@@ -57,18 +57,20 @@ _UNSENT_MARKERS = (
 )
 
 
-def provably_unsent(e: BaseException) -> bool:
+def provably_unsent(e: BaseException, peer=None) -> bool:
     """True when a failed peer call provably never DELIVERED the request —
     i.e. retrying it cannot double-apply hits on the peer.
 
     Covers: local shutdown / queue-full (PeerNotReadyError raised before
-    any RPC), and UNAVAILABLE whose error data shows the connection was
-    never established.  A mid-RPC socket reset or timeout is NOT provably
-    unsent (the peer may have applied the batch before the response was
-    lost).  Duck-typed over code()/details()/debug_error_string() so the
-    classification is testable without fabricating cython AioRpcError
-    instances, and resilient to which field grpc-core puts the cause in.
-    """
+    any RPC), and UNAVAILABLE on a channel that structurally NEVER reached
+    READY (`peer.ever_connected()` — no connection has ever existed, so
+    nothing can have been delivered; no error-string matching needed).
+    The marker-string heuristic over details()/debug_error_string()
+    remains as a fallback for ever-connected channels whose failure text
+    names a connect-phase cause.  A mid-RPC socket reset or timeout is
+    NOT provably unsent (the peer may have applied the batch before the
+    response was lost).  Duck-typed so the classification is testable
+    without fabricating cython AioRpcError instances."""
     if isinstance(e, PeerNotReadyError):
         return True
     code = getattr(e, "code", None)
@@ -79,6 +81,10 @@ def provably_unsent(e: BaseException) -> bool:
             return False
     except Exception:  # noqa: BLE001
         return False
+    if peer is not None:
+        ever = getattr(peer, "ever_connected", None)
+        if callable(ever) and not ever():
+            return True
     text = ""
     for attr in ("details", "debug_error_string"):
         f = getattr(e, attr, None)
@@ -124,9 +130,72 @@ class PeerClient:
         self._drained = asyncio.Event()
         self._drained.set()
         self._errors: Deque[Tuple[float, str]] = collections.deque(maxlen=100)
+        # Structural unsent-classification state: has this channel EVER
+        # reached READY?  Set by the `_ensure_ready` pre-dial gate (and
+        # by any RPC completing).  While False, NO RPC has ever been
+        # issued on the channel — every RPC path gates on readiness
+        # first — so a failure before that point provably delivered
+        # nothing.
+        self._ever_ready = False
 
     def info(self) -> PeerInfo:
         return self.peer_info
+
+    def ever_connected(self) -> bool:
+        """True once this peer's channel has been observed READY (the
+        `_ensure_ready` gate) or any RPC completed.  provably_unsent's
+        structural signal: while False, no request was ever handed to the
+        transport (the gate runs BEFORE the first RPC is issued), so a
+        failure is retry-safe without inspecting error strings — there is
+        no delivered-but-unanswered window, unlike a passive readiness
+        watcher which can miss a short-lived READY."""
+        return self._ever_ready
+
+    async def _ensure_ready(self) -> None:
+        """Pre-dial gate: on a channel that has never been READY, wait
+        for readiness BEFORE issuing the first RPC (the reference
+        connects first for the same reason, peer_client.go:318).  Fails
+        FAST on the first failed dial attempt (TRANSIENT_FAILURE — e.g.
+        connection refused), matching the latency of an ungated RPC's
+        dial error.  Any failure here raises PeerNotReadyError — provably
+        unsent, since no request has been issued on the channel yet,
+        whatever states the channel may have blinked through.  After the
+        first readiness this is a no-op."""
+        if self._ever_ready:
+            return
+        ch = self._channel
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.behavior.batch_timeout_s
+        why = "timed out"
+        state = ch.get_state(try_to_connect=True)
+        while state != grpc.ChannelConnectivity.READY:
+            if state in (
+                grpc.ChannelConnectivity.TRANSIENT_FAILURE,
+                grpc.ChannelConnectivity.SHUTDOWN,
+            ):
+                why = f"dial failed ({state.name})"
+                break
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(
+                    ch.wait_for_state_change(state), timeout=remaining
+                )
+            except asyncio.TimeoutError:
+                break
+            state = ch.get_state(try_to_connect=True)
+        else:
+            self._ever_ready = True
+            return
+        # A failed first dial is a peer error like any other: the health
+        # check's rolling window must see it even though no RPC was ever
+        # issued on the channel.
+        msg = (
+            f"peer {self.peer_info.grpc_address} never connected: {why}"
+        )
+        self._record_error(msg)
+        raise PeerNotReadyError(msg)
 
     # -- connection ------------------------------------------------------
     async def _connect(self) -> grpc_api.PeersV1Stub:
@@ -233,9 +302,12 @@ class PeerClient:
         self._track_inflight(+1)
         try:
             await self._connect()
-            return await self._raw_get_peer_rate_limits(
+            await self._ensure_ready()
+            out = await self._raw_get_peer_rate_limits(
                 payload, timeout=self.behavior.batch_timeout_s
             )
+            self._ever_ready = True
+            return out
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
             raise
@@ -254,12 +326,14 @@ class PeerClient:
         self._track_inflight(+1)
         try:
             stub = await self._connect()
+            await self._ensure_ready()
             req = peers_pb2.UpdatePeerGlobalsReq(
                 globals=[grpc_api.global_to_pb(g) for g in globals_]
             )
             await stub.UpdatePeerGlobals(
                 req, timeout=self.behavior.batch_timeout_s
             )
+            self._ever_ready = True
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
             raise
@@ -367,15 +441,20 @@ class PeerClient:
                     peerAddr=self.peer_info.grpc_address
                 ).observe(time.monotonic() - start)
             if len(resps) != len(batch):
-                raise PeerNotReadyError(
-                    "peer returned %d responses for %d requests"
-                    % (len(resps), len(batch))
+                msg = "peer returned %d responses for %d requests" % (
+                    len(resps), len(batch)
                 )
+                self._record_error(msg)
+                raise PeerNotReadyError(msg)
             for (_, fut), resp in zip(batch, resps):
                 if not fut.done():
                     fut.set_result(resp)
         except Exception as e:  # noqa: BLE001 — propagate to all waiters
-            self._record_error(str(e))
+            # PeerNotReadyErrors were already recorded at their source
+            # (the pre-dial gate / the mismatch above) — recording again
+            # would double-count them in the health window.
+            if not isinstance(e, PeerNotReadyError):
+                self._record_error(str(e))
             err: Exception = e
             if isinstance(e, grpc.aio.AioRpcError) and e.code() in (
                 grpc.StatusCode.UNAVAILABLE,
@@ -390,10 +469,12 @@ class PeerClient:
         self, reqs: List[RateLimitReq]
     ) -> List[RateLimitResp]:
         stub = await self._connect()
+        await self._ensure_ready()
         pb_req = peers_pb2.GetPeerRateLimitsReq(
             requests=[grpc_api.req_to_pb(r) for r in reqs]
         )
         pb_resp = await stub.GetPeerRateLimits(
             pb_req, timeout=self.behavior.batch_timeout_s
         )
+        self._ever_ready = True
         return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

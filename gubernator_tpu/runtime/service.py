@@ -332,9 +332,27 @@ class Service:
 
         single_node = self.local_picker.size() == 0
         for i, req in enumerate(reqs):
-            # Validation happens in the packer for local requests; forwarded
-            # requests are validated by the owner.  Pre-validate here only to
-            # avoid forwarding junk.
+            # Client-side validation BEFORE routing (gubernator.go:228-237):
+            # an invalid request answers inline — it is never forwarded (no
+            # owner metadata on its error) and never queues GLOBAL updates
+            # or MULTI_REGION hits.  The peer RPC keeps the owner-side
+            # packer validation with QueueUpdate-before-algorithm semantics.
+            if not req.unique_key:
+                self.metrics.check_error_counter.labels(
+                    error="Invalid request"
+                ).inc()
+                responses[i] = RateLimitResp(
+                    error="field 'unique_key' cannot be empty"
+                )
+                continue
+            if not req.name:
+                self.metrics.check_error_counter.labels(
+                    error="Invalid request"
+                ).inc()
+                responses[i] = RateLimitResp(
+                    error="field 'namespace' cannot be empty"
+                )
+                continue
             key = req.hash_key()
             is_global = has_behavior(req.behavior, Behavior.GLOBAL)
             if single_node:
@@ -883,7 +901,7 @@ class GlobalManager:
                     )
                     self.async_sends += 1
                 except Exception as e:  # noqa: BLE001
-                    if provably_unsent(e):
+                    if provably_unsent(e, peer):
                         # Shutdown / queue-full / connect-refused provably
                         # precede any delivery, so re-queueing cannot double
                         # count; a transiently unreachable owner keeps the

@@ -7,6 +7,7 @@ import asyncio
 import pytest
 
 from gubernator_tpu.core.config import (
+    BehaviorConfig,
     DaemonConfig,
     DeviceConfig,
     fast_test_behaviors,
@@ -19,7 +20,7 @@ DEV = DeviceConfig(num_slots=4096, ways=8, batch_size=128)
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 async def _spawn_daemon() -> Daemon:
@@ -231,6 +232,81 @@ def test_provably_unsent_classification():
         grpc.StatusCode.DEADLINE_EXCEEDED, details="Deadline Exceeded"
     ))
     assert not provably_unsent(ValueError("not an rpc error"))
+
+    # STRUCTURAL tier: a channel that never reached READY classifies as
+    # unsent with the detail strings fully scrambled — no text matching.
+    class FakePeer:
+        def __init__(self, ever):
+            self._ever = ever
+
+        def ever_connected(self):
+            return self._ever
+
+    scrambled = FakeRpcError(
+        grpc.StatusCode.UNAVAILABLE,
+        details="xq zvlk 9#! qpr",
+        debug="tnesnu ylbavorp ton si siht",
+    )
+    assert provably_unsent(scrambled, FakePeer(ever=False))
+    # Ever-connected channel + scrambled text: NOT provably unsent (the
+    # batch may have been applied before the failure).
+    assert not provably_unsent(scrambled, FakePeer(ever=True))
+    # Ever-connected + explicit connect-phase wording: text fallback.
+    assert provably_unsent(
+        FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, details="connection refused"
+        ),
+        FakePeer(ever=True),
+    )
+    # Structural tier never applies to non-UNAVAILABLE codes.
+    assert not provably_unsent(
+        FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED, details="x"),
+        FakePeer(ever=False),
+    )
+
+
+def test_ever_connected_tracking():
+    """PeerClient.ever_connected(): a dead port fails the pre-dial gate
+    with PeerNotReadyError BEFORE any RPC is issued (structurally
+    provably unsent — no delivered-but-unanswered window exists), and
+    one successful RPC against a live daemon flips the flag."""
+    from gubernator_tpu.net.peer_client import PeerClient, provably_unsent
+    from gubernator_tpu.testing import Cluster
+
+    async def dead_port():
+        b = BehaviorConfig(batch_timeout_s=0.5)
+        peer = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"), behavior=b)
+        assert not peer.ever_connected()
+        try:
+            await peer.get_peer_rate_limits_batch([
+                RateLimitReq(name="n", unique_key="k", hits=1, limit=5,
+                             duration=60_000)
+            ])
+            raise AssertionError("expected dial failure")
+        except PeerNotReadyError as e:
+            assert not peer.ever_connected()
+            assert provably_unsent(e, peer)  # structural, no text needed
+        await peer.shutdown()
+
+    run(dead_port())
+
+    c = Cluster.start(1)
+    try:
+        async def live_peer():
+            peer = PeerClient(
+                PeerInfo(grpc_address=c.addresses()[0])
+            )
+            resps = await peer.get_peer_rate_limits_batch([
+                RateLimitReq(name="n", unique_key="k", hits=1, limit=5,
+                             duration=60_000)
+            ])
+            assert resps[0].remaining == 4
+            assert peer.ever_connected()
+            await peer.shutdown()
+
+        c.run(live_peer(), timeout=60)
+    finally:
+        c.stop()
 
 
 def test_batcher_cancel_fails_dequeued_waiters():
