@@ -158,6 +158,14 @@ class DaemonConfig:
     # monotone across depths 1>2>3>4>6).  Raise only if profiling shows
     # host-side gather/serialize starving the device between merges.
     fastpath_inflight: int = 1
+    # Sparse-overlap threshold (requests): a fast-lane drain at most this
+    # big may overlap the in-flight merge instead of waiting out its
+    # response sync.  Default OFF: A/B on the tunnel rig (r4) showed no
+    # small-batch p50 win (the dispatch->sync turnaround dominates and
+    # does not overlap there) and ~6% token-config throughput cost.  On
+    # co-located hosts, where a sync is microseconds, the tradeoff may
+    # differ — re-measure before enabling.
+    fastpath_sparse: int = 0
 
 
 @dataclass
@@ -314,6 +322,10 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         fastpath_inflight=_require_min(
             "GUBER_FASTPATH_INFLIGHT",
             _env_int("GUBER_FASTPATH_INFLIGHT", 1), 1,
+        ),
+        fastpath_sparse=_require_min(
+            "GUBER_FASTPATH_SPARSE",
+            _env_int("GUBER_FASTPATH_SPARSE", 0), 0,
         ),
     )
 

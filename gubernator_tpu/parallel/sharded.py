@@ -124,22 +124,17 @@ def make_sharded_step_packed(mesh, ways: int):
 
 
 def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed [n, 8, B] responses — one transfer per round.
-    Field arrays are [n, B], so (shard, lane) positions index directly."""
-    out = []
-    for p in round_resps:
-        a = np.asarray(p)
-        out.append({
-            "status": a[:, 0],
-            "limit": a[:, 1],
-            "remaining": a[:, 2],
-            "reset_time": a[:, 3],
-            "persisted": a[:, 4],
-            "found": a[:, 5],
-            "stored": a[:, 6],
-            "cached": a[:, 7],
-        })
-    return out
+    """Host view of packed [n, 8, B] responses — ONE transfer for all
+    rounds (fetch_ravel).  Field arrays are [n, B], so (shard, lane)
+    positions index directly."""
+    from gubernator_tpu.runtime.backend import (
+        _packed_resp_dict,
+        fetch_ravel,
+    )
+
+    return [
+        _packed_resp_dict(a) for a in fetch_ravel(list(round_resps))
+    ]
 
 
 def make_sharded_row_op(mesh, ways: int, impl, row_type):
@@ -597,23 +592,44 @@ class MeshBackend(PersistenceHost):
             ))
         return token
 
-    def _gather_rows_finish(self, token, m: int):
-        """Fetch dispatched row gathers into (int64[10, m] columns in
-        ops/step.GATHER_ROW_FIELDS order, float64[m] remaining_f), in
-        fingerprint order."""
+    def _gather_rows_int_arrays(self, token) -> list:
+        """The token's int64 device buffers — exposed so a caller can fold
+        them into ONE fetch_ravel round-trip with its response buffers."""
+        return [d for (d, _rf), _jv in token]
+
+    def _gather_rows_rf_arrays(self, token) -> list:
+        return [rf for (_d, rf), _jv in token]
+
+    def _gather_rows_build(self, token, m: int, int_hosts,
+                           rf_hosts=None):
+        """Assemble (int64[10, m] GATHER_ROW_FIELDS columns, float64[m]
+        remaining_f) from pre-fetched host chunks via each chunk's
+        shard/lane placement grid.  rf_hosts=None -> zeros (no leaky row
+        captured)."""
         from gubernator_tpu.ops.step import GATHER_ROW_FIELDS
 
         out = np.zeros((len(GATHER_ROW_FIELDS), m), dtype=np.int64)
         rf = np.zeros(m, dtype=np.float64)
-        for (d, drf), jv in token:
-            a = np.asarray(d)    # [n_shards, 10, B]
-            f = np.asarray(drf)  # [n_shards, B]
+        for i, (_devs, jv) in enumerate(token):
+            a = int_hosts[i]     # [n_shards, 10, B]
+            f = rf_hosts[i] if rf_hosts is not None else None
             for s in range(a.shape[0]):
                 sel = jv[s] >= 0
                 if sel.any():
                     out[:, jv[s][sel]] = a[s][:, sel]
-                    rf[jv[s][sel]] = f[s][sel]
+                    if f is not None:
+                        rf[jv[s][sel]] = f[s][sel]
         return out, rf
+
+    def _gather_rows_finish(self, token, m: int):
+        """Fetch + assemble in two packed round-trips (ints, rf)."""
+        from gubernator_tpu.runtime.backend import fetch_ravel
+
+        return self._gather_rows_build(
+            token, m,
+            fetch_ravel(self._gather_rows_int_arrays(token)),
+            fetch_ravel(self._gather_rows_rf_arrays(token)),
+        )
 
     def _bulk_upsert(
         self, rows: List[dict], hashes: List[int], now: int

@@ -467,9 +467,9 @@ class DeviceBackend(PersistenceHost):
             padded[: len(chunk)] = chunk
             devs.append(self._probe(self.table, padded, np.int64(now))[0])
         out = np.zeros(len(hashes), dtype=bool)
-        for i, d in enumerate(devs):
+        for i, d in enumerate(fetch_ravel(devs)):
             lo = i * B
-            out[lo:lo + B] = np.asarray(d)[: len(hashes) - lo]
+            out[lo:lo + B] = d[: len(hashes) - lo]
         return out
 
     def _gather_rows_dispatch(self, h64: np.ndarray, now: int):
@@ -488,10 +488,22 @@ class DeviceBackend(PersistenceHost):
             )
         return token
 
-    def _gather_rows_finish(self, token, m: int):
-        """Fetch dispatched row gathers into (int64[10, m] columns in
-        ops/step.GATHER_ROW_FIELDS order, float64[m] remaining_f), in
-        fingerprint order."""
+    def _gather_rows_int_arrays(self, token) -> list:
+        """The token's int64 device buffers — exposed so a caller can fold
+        them into ONE fetch_ravel round-trip with its response buffers."""
+        return [d for d, _rf in token]
+
+    def _gather_rows_rf_arrays(self, token) -> list:
+        """The token's float64 remaining_f buffers (needed only when a
+        leaky row may have been captured — token rows read remaining from
+        the int columns)."""
+        return [rf for _d, rf in token]
+
+    def _gather_rows_build(self, token, m: int, int_hosts,
+                           rf_hosts=None):
+        """Assemble (int64[10, m] GATHER_ROW_FIELDS columns, float64[m]
+        remaining_f) from pre-fetched host chunks.  rf_hosts=None means
+        the caller proved no leaky row was captured (zeros)."""
         from gubernator_tpu.ops.step import GATHER_ROW_FIELDS
 
         if not token:
@@ -499,11 +511,20 @@ class DeviceBackend(PersistenceHost):
                 np.zeros((len(GATHER_ROW_FIELDS), 0), dtype=np.int64),
                 np.zeros(0),
             )
-        packed = np.concatenate(
-            [np.asarray(d) for d, _rf in token], axis=1
-        )[:, :m]
-        rf = np.concatenate([np.asarray(r) for _d, r in token])[:m]
+        packed = np.concatenate(int_hosts, axis=1)[:, :m]
+        rf = (
+            np.concatenate(rf_hosts)[:m] if rf_hosts is not None
+            else np.zeros(m)
+        )
         return packed, rf
+
+    def _gather_rows_finish(self, token, m: int):
+        """Fetch + assemble in two packed round-trips (ints, rf)."""
+        return self._gather_rows_build(
+            token, m,
+            fetch_ravel(self._gather_rows_int_arrays(token)),
+            fetch_ravel(self._gather_rows_rf_arrays(token)),
+        )
 
     def warmup(self) -> None:
         """Compile the hot-path executables with a synthetic batch that
@@ -737,23 +758,54 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
     ]
 
 
+def fetch_ravel(arrs) -> List[np.ndarray]:
+    """ONE device->host round-trip for many same-dtype device arrays: ravel-
+    concat on device, single transfer, split + reshape on host.
+
+    On remote-device rigs every host fetch costs a full tunnel
+    round-trip even when the data is already computed, so a merge's N
+    response buffers fetched separately pay N cycles — packed they pay
+    one (measured 307ms -> 119ms for four [8, 4096] rounds).  Co-located
+    the concat is a trivial device op."""
+    if not arrs:
+        return []
+    if len(arrs) == 1:
+        return [np.asarray(arrs[0])]
+    import jax.numpy as jnp
+
+    flat = jnp.concatenate([a.ravel() for a in arrs])
+    host = np.asarray(flat)
+    out = []
+    off = 0
+    for a in arrs:
+        n = int(np.prod(a.shape))
+        out.append(host[off:off + n].reshape(a.shape))
+        off += n
+    return out
+
+
+def _packed_resp_dict(a: np.ndarray) -> Dict[str, np.ndarray]:
+    """apply_batch_packed row order -> named host columns; `a` is
+    [8, B] (single table) or [n, 8, B] (grid, leading shard dim)."""
+    sl = (slice(None),) * (a.ndim - 2)
+    return {
+        "status": a[sl + (0,)],
+        "limit": a[sl + (1,)],
+        "remaining": a[sl + (2,)],
+        "reset_time": a[sl + (3,)],
+        "persisted": a[sl + (4,)],
+        "found": a[sl + (5,)],
+        "stored": a[sl + (6,)],
+        "cached": a[sl + (7,)],
+    }
+
+
 def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
     """Host view of packed int64[8, B] responses (apply_batch_packed row
-    order), one transfer per round."""
-    out = []
-    for p in round_packed:
-        a = np.asarray(p)
-        out.append({
-            "status": a[0],
-            "limit": a[1],
-            "remaining": a[2],
-            "reset_time": a[3],
-            "persisted": a[4],
-            "found": a[5],
-            "stored": a[6],
-            "cached": a[7],
-        })
-    return out
+    order) — ONE transfer for all rounds (fetch_ravel)."""
+    return [
+        _packed_resp_dict(a) for a in fetch_ravel(list(round_packed))
+    ]
 
 
 def tally_from_rounds(rounds, round_host) -> "Tally":

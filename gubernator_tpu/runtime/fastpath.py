@@ -88,16 +88,34 @@ class _Coalescer:
     in the queue; each drain takes the WHOLE queue as one merge (bigger
     merges amortize the per-merge device round-trip).  `process` runs on
     a pool thread with the drained entry list and returns one result per
-    entry, delivered through each entry's future."""
+    entry, delivered through each entry's future.
 
-    def __init__(self, pool, process, max_inflight: int = 1) -> None:
+    Adaptive sparse overlap (`sparse_limit` > 0): a drain no bigger than
+    `sparse_limit` requests that would otherwise WAIT for the in-flight
+    merge's response sync may instead dispatch on ONE overlap slot — at
+    low load an arrival then costs ~1 device round-trip instead of ~2
+    (the reference's batcher fires its window early when sparse,
+    peer_client.go:373-446).  Under load drains exceed the limit and the
+    strict depth-1 maximal-merge discipline holds (measured monotone
+    1>2>3>4>6 on the tunnel rig — see FastPath below)."""
+
+    def __init__(self, pool, process, max_inflight: int = 1,
+                 sparse_limit: int = 0, size_of=None) -> None:
         self._pool = pool
         self._process = process
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self._inflight = asyncio.Semaphore(max_inflight)
+        self._overlap = asyncio.Semaphore(1)
+        self._sparse_limit = sparse_limit
+        self._size_of = size_of or (lambda e: 1)
         self._dispatches: set = set()
         self._closed = False
+        # Observability: total drains / drains that rode the overlap slot
+        # / drains that had to wait for the in-flight merge's slot.
+        self.drains = 0
+        self.overlap_drains = 0
+        self.waited_drains = 0
 
     async def do(self, entry):
         """Submit an entry and await its result."""
@@ -109,31 +127,58 @@ class _Coalescer:
         await self._queue.put(entry)
         return await entry.fut
 
+    def _drain_into(self, entries: list) -> None:
+        while True:
+            try:
+                entries.append(self._queue.get_nowait())
+            except asyncio.QueueEmpty:
+                return
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             first = await self._queue.get()
-            # Take the slot BEFORE draining: while merges are in flight,
-            # arrivals keep accumulating and ship as ONE bigger merge.
-            try:
-                await self._inflight.acquire()
-            except asyncio.CancelledError:
-                # Shutdown while holding a dequeued entry: fail it
-                # instead of orphaning its awaiting handler.
-                if not first.fut.done():
-                    first.fut.set_exception(RuntimeError("fastpath closed"))
-                raise
             entries = [first]
-            while True:
-                try:
-                    entries.append(self._queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            task = asyncio.ensure_future(self._dispatch(loop, entries))
+            self._drain_into(entries)
+            self.drains += 1
+            try:
+                if not self._inflight.locked():
+                    await self._inflight.acquire()  # immediate
+                    sem = self._inflight
+                elif (
+                    self._sparse_limit > 0
+                    and not self._overlap.locked()
+                    and sum(self._size_of(e) for e in entries)
+                    <= self._sparse_limit
+                ):
+                    # Sparse drain while a merge is in flight: overlap
+                    # instead of waiting out its response sync.
+                    await self._overlap.acquire()
+                    sem = self._overlap
+                    self.overlap_drains += 1
+                else:
+                    # Loaded: hold for the slot; arrivals keep
+                    # accumulating and ship as ONE bigger merge.
+                    self.waited_drains += 1
+                    await self._inflight.acquire()
+                    sem = self._inflight
+                    self._drain_into(entries)
+            except asyncio.CancelledError:
+                # Shutdown while holding dequeued entries: fail them
+                # instead of orphaning their awaiting handlers.
+                for en in entries:
+                    if not en.fut.done():
+                        en.fut.set_exception(
+                            RuntimeError("fastpath closed")
+                        )
+                raise
+            task = asyncio.ensure_future(
+                self._dispatch(loop, entries, sem)
+            )
             self._dispatches.add(task)
             task.add_done_callback(self._dispatches.discard)
 
-    async def _dispatch(self, loop, entries) -> None:
+    async def _dispatch(self, loop, entries, sem) -> None:
         try:
             outs = await loop.run_in_executor(
                 self._pool, lambda: self._process(entries)
@@ -153,7 +198,7 @@ class _Coalescer:
                 if not en.fut.done():
                     en.fut.set_result(out)
         finally:
-            self._inflight.release()
+            sem.release()
 
     async def close(self) -> None:
         self._closed = True  # new do() calls fail fast, never respawn _run
@@ -188,16 +233,24 @@ class FastPath:
     mutation path (this lane, the object path, the GLOBAL managers)
     exactly like any other single-writer section."""
 
-    def __init__(self, service, max_inflight: int = 1) -> None:
+    def __init__(self, service, max_inflight: int = 1,
+                 sparse_limit: int = 0) -> None:
         if max_inflight < 1:
             raise ValueError(
                 f"fastpath max_inflight must be >= 1, got {max_inflight}"
             )
         self.s = service
+        # One extra worker backs the sparse-overlap slot, or its merge
+        # would queue behind the in-flight one in this very pool.
         self._pool = ThreadPoolExecutor(
-            max_workers=max_inflight, thread_name_prefix="tpu-fastlane"
+            max_workers=max_inflight + (1 if sparse_limit > 0 else 0),
+            thread_name_prefix="tpu-fastlane",
         )
-        self._mach = _Coalescer(self._pool, self._process, max_inflight)
+        self._mach = _Coalescer(
+            self._pool, self._process, max_inflight,
+            sparse_limit=sparse_limit,
+            size_of=lambda e: e.cols.n,
+        )
         # The sketch and engine lanes each coalesce cross-RPC into one
         # maximal merge at a time, on DEDICATED workers so machinery
         # syncs can't starve them (and vice versa).
@@ -1067,14 +1120,13 @@ class FastPath:
             now,
         )
 
-    def _build_captured(self, backend, uniq, cap_fps, token) -> list:
+    def _build_captured(self, uniq, cap_fps, a, rf) -> list:
         """CacheItems from the packed gather columns (GATHER_ROW_FIELDS
         order) — misses and KIND_CACHED_RESP rows are skipped exactly like
         _read_items_locked."""
         from gubernator_tpu.core.types import Algorithm, CacheItem, Status
         from gubernator_tpu.ops.state import KIND_CACHED_RESP
 
-        a, rf = backend._gather_rows_finish(token, len(cap_fps))
         out = []
         for j, fp in enumerate(cap_fps):
             if not a[0, j] or a[1, j] == KIND_CACHED_RESP:
@@ -1314,11 +1366,37 @@ class FastPath:
             if do_store:
                 captured: list = []
                 try:
+                    from gubernator_tpu.runtime.backend import (
+                        _packed_resp_dict,
+                        fetch_ravel,
+                    )
+
+                    # ONE packed round-trip fetches the responses AND the
+                    # capture's int columns together; remaining_f (its own
+                    # dtype) rides a second trip only when a leaky row can
+                    # have been captured.  A store drain thus costs 2-3
+                    # fetch cycles total (seed probe + this) vs 1
+                    # storeless.
+                    cap_ints = backend._gather_rows_int_arrays(cap_token)
                     if plan is None:
-                        host = to_host(resps)
+                        hosts = fetch_ravel(list(resps) + cap_ints)
+                        nr = len(resps)
+                        host = [_packed_resp_dict(h) for h in hosts[:nr]]
                         gather(host)
+                        int_hosts = hosts[nr:]
+                    else:
+                        int_hosts = fetch_ravel(cap_ints)
+                    rf_hosts = (
+                        fetch_ravel(
+                            backend._gather_rows_rf_arrays(cap_token)
+                        )
+                        if bool((algo == 1).any()) else None
+                    )
+                    a_cols, rf_col = backend._gather_rows_build(
+                        cap_token, len(cap_fps), int_hosts, rf_hosts
+                    )
                     captured = self._build_captured(
-                        backend, uniq, cap_fps, cap_token
+                        uniq, cap_fps, a_cols, rf_col
                     )
                 finally:
                     # The ticket MUST be redeemed even if any fetch fails
