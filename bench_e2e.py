@@ -73,6 +73,21 @@ async def drive(
     return count, lat
 
 
+def _rt_mark(d) -> dict:
+    """Snapshot one daemon's device round-trip counters."""
+    svc = d.service
+    eng = d.fastpath._engine_lane
+    return {
+        "fastlane_drains": d.fastpath._mach.drains,
+        "engine_drains": eng.drains if eng is not None else 0,
+        "batcher_steps": svc._local_batcher.steps,
+        "reread_batches": svc.global_mgr.reread_batches,
+        "reread_keys": svc.global_mgr.reread_keys,
+        "hit_flush_rpcs": svc.global_mgr.async_sends,
+        "broadcast_rpcs": svc.global_mgr.broadcasts,
+    }
+
+
 def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
                   algorithm=0, behavior=0, burst=0) -> bytes:
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -169,6 +184,84 @@ def bench(seconds: float, concurrency: int) -> None:
         emit("latency_small_batch", rpcs * 10, rpcs, lat,
              time.perf_counter() - t0, {"concurrency": 4})
 
+        # Latency decomposition -> the implied CO-LOCATED bound.  The rig
+        # pays a ~100-300ms dispatch->fetch turnaround per merge through
+        # the axon tunnel; a co-located TPU host pays the device's actual
+        # step time plus a tens-of-µs interconnect sync.  Measure each
+        # component, then state the bound as:
+        #   implied = measured_p50 - merge_turnaround + device_step_exec
+        # (every term measured on this rig; the only excluded cost is the
+        # co-located PCIe/ICI sync itself, which is orders of magnitude
+        # below the stated bound).
+        be = c.daemons[0].service.backend
+        import jax as _jax
+
+        def merge_cycle_ms(reps: int = 5) -> float:
+            """One small-batch merge's dispatch->fetch cycle on this rig."""
+            q = np.zeros((12, 128), dtype=np.int64)
+            now = np.int64(be.clock.millisecond_now())
+            with be._lock:
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    be.table, resp = be._step_packed_q(be.table, q, now)
+                    np.asarray(resp)
+                return (time.perf_counter() - t0) / reps * 1e3
+
+        def step_exec_ms(k: int = 50) -> float:
+            """Amortized per-step device execution under pipelined
+            dispatch (the co-located cost of one merge's compute)."""
+            q = np.zeros((12, 128), dtype=np.int64)
+            now = np.int64(be.clock.millisecond_now())
+            with be._lock:
+                # One throwaway cycle to settle the pipe.
+                be.table, r0 = be._step_packed_q(be.table, q, now)
+                np.asarray(r0)
+                t0 = time.perf_counter()
+                resps = []
+                for _ in range(k):
+                    be.table, r = be._step_packed_q(be.table, q, now)
+                    resps.append(r)
+                _jax.block_until_ready(resps)
+                wall = (time.perf_counter() - t0) * 1e3
+            return max(wall - turnaround_ms, 0.0) / k
+
+        turnaround_ms = merge_cycle_ms()
+        exec_ms = step_exec_ms()
+        # Wire loopback WITHOUT the device: an empty GetRateLimitsReq
+        # rides the full gRPC + fast-lane parse/serialize path and
+        # returns before any device work — the co-located non-device
+        # latency floor, measured through real sockets at the same
+        # concurrency as the latency config.
+        empty = build_payload([])
+        _, lb_lat = c.run(drive(addr, [empty], 2.0, 4), timeout=120)
+        lb50, lb99 = _percentiles(lb_lat)
+        lat_line = next(
+            r for r in results if r["config"] == "latency_small_batch"
+        )
+        bound = {
+            "config": "colocated_latency_bound",
+            "note": (
+                "wire loopback (gRPC + parse/serialize through real "
+                "sockets, no device) plus TWO pipelined merge executions "
+                "(a small-batch request spans at most the in-flight "
+                "merge plus its own under the depth-1 drain discipline); "
+                "every term measured on this rig — the co-located "
+                "interconnect sync (tens of µs) is the only excluded "
+                "cost.  The rig's measured merge turnaround is what "
+                "co-location removes."
+            ),
+            "wire_loopback_p50_ms": round(lb50, 3),
+            "wire_loopback_p99_ms": round(lb99, 3),
+            "device_step_exec_ms": round(exec_ms, 3),
+            "rig_merge_turnaround_ms": round(turnaround_ms, 2),
+            "measured_rig_p50_ms": lat_line["p50_ms"],
+            "measured_rig_p99_ms": lat_line["p99_ms"],
+            "implied_colocated_p50_ms": round(lb50 + 2 * exec_ms, 3),
+            "implied_colocated_p99_ms": round(lb99 + 2 * exec_ms, 3),
+        }
+        results.append(bound)
+        print(json.dumps(bound), flush=True)
+
         # Host/device budget on the fast lane (per 1000-request batch).
         fp = c.daemons[0].fastpath
         from gubernator_tpu import native
@@ -203,6 +296,46 @@ def bench(seconds: float, concurrency: int) -> None:
     finally:
         c.stop()
 
+    # ---- config 2b: token bucket with a Store attached ----------------
+    # The persistence SPI rides the fast lane (r4): each drain adds one
+    # residency probe + one packed capture gather + per-unique-key
+    # on_change delivery.  Must land within ~2x of the storeless token
+    # config.
+    from gubernator_tpu.core.config import DaemonConfig
+
+    try:
+        from gubernator_tpu.runtime.store import MockStore
+
+        store_conf = DaemonConfig(device=dev_cfg)
+        store_conf.store = MockStore()
+        c = Cluster.start_with(
+            [""], device=dev_cfg, conf_template=store_conf
+        )
+        try:
+            addr = [c.daemons[0].grpc_address]
+            pays = [
+                build_payload(
+                    [("bench_store", f"k{i}") for i in range(1000)]
+                )
+            ]
+            c.run(drive(addr, pays, 1.0, concurrency), timeout=120)
+            t0 = time.perf_counter()
+            rpcs, lat = c.run(
+                drive(addr, pays, seconds, concurrency), timeout=120
+            )
+            st = store_conf.store
+            emit("token_1k_store", rpcs * 1000, rpcs, lat,
+                 time.perf_counter() - t0, {
+                     "store_gets": st.called["get"],
+                     "store_on_changes": st.called["on_change"],
+                     "fastpath_served": c.daemons[0].fastpath.served,
+                     "fastpath_fallbacks": c.daemons[0].fastpath.fallbacks,
+                 })
+        finally:
+            c.stop()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"config": "token_1k_store", "error": str(e)}))
+
     # ---- config 3: GLOBAL on a 4-daemon cluster -----------------------
     try:
         c = Cluster.start_with(["", "", "", ""], device=dev_cfg)
@@ -217,12 +350,50 @@ def bench(seconds: float, concurrency: int) -> None:
             ]
             addr = [c.daemons[0].grpc_address]
             c.run(drive(addr, g_pays, 1.0, concurrency), timeout=120)
+            marks = [_rt_mark(d) for d in c.daemons]
             t0 = time.perf_counter()
             rpcs, lat = c.run(
                 drive(addr, g_pays, seconds, concurrency), timeout=120
             )
-            emit("global_4peer", rpcs * 1000, rpcs, lat,
-                 time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            emit("global_4peer", rpcs * 1000, rpcs, lat, wall)
+            # Device round-trip accounting (VERDICT r3 #3): every device
+            # dispatch->fetch cycle each daemon ran during the window,
+            # by component, and the implied cycles per 1000 checks.
+            per_node = [
+                {k: after[k] - before[k] for k in after}
+                for before, after in zip(
+                    marks, [_rt_mark(d) for d in c.daemons]
+                )
+            ]
+            total_cycles = sum(
+                n["fastlane_drains"] + n["engine_drains"]
+                + n["batcher_steps"] for n in per_node
+            )
+            acct = {
+                "config": "global_roundtrip_accounting",
+                "note": (
+                    "per-daemon device dispatch->fetch cycles during the "
+                    "global_4peer window.  fastlane_drains serve client "
+                    "AND forwarded peer batches (one cycle each); "
+                    "batcher_steps are object-path steps — on this "
+                    "cluster exclusively the broadcast zero-hit re-reads "
+                    "(reread_batches), whose re-read semantics the "
+                    "reference shares (global.go:205-250) and which stay "
+                    "OFF the compiled lane on purpose: merged re-reads "
+                    "break same-key cascade eligibility (A/B'd 20k -> 5k "
+                    "checks/s).  Broadcast RECEIVES (apply_cached_rows) "
+                    "dispatch without a fetch and cost no cycle."
+                ),
+                "checks": rpcs * 1000,
+                "cluster_cycles": total_cycles,
+                "cycles_per_1000_checks": round(
+                    total_cycles / max(rpcs, 1), 2
+                ),
+                "per_node": per_node,
+            }
+            results.append(acct)
+            print(json.dumps(acct), flush=True)
         finally:
             c.stop()
     except Exception as e:  # noqa: BLE001 — isolate config failures

@@ -690,6 +690,8 @@ class LocalBatcher:
         self.max_coalesce = max_coalesce
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # Device steps this batcher ran (round-trip accounting).
+        self.steps = 0
 
     async def check(
         self,
@@ -722,6 +724,7 @@ class LocalBatcher:
                 merged_cached.extend(
                     cached if cached is not None else [False] * len(reqs)
                 )
+            self.steps += 1
             try:
                 resps = await loop.run_in_executor(
                     self.s._dev_executor,
@@ -831,6 +834,10 @@ class GlobalManager:
         # assertions, functional_test.go:843-867).
         self.async_sends = 0
         self.broadcasts = 0
+        # Round-trip accounting: zero-hit broadcast re-read batches/keys
+        # (each batch is one LocalBatcher device step).
+        self.reread_batches = 0
+        self.reread_keys = 0
 
     def start(self) -> None:
         if self._tasks:
@@ -935,6 +942,18 @@ class GlobalManager:
             self._take_updates, self._broadcast_peers,
         )
 
+    async def _read_statuses(self, reads) -> List[RateLimitResp]:
+        """Zero-hit status re-read for the broadcast, on the OBJECT path.
+
+        Deliberately NOT routed through the compiled lane: re-read lanes
+        share keys with in-flight client GLOBAL merges, and a key whose
+        occurrences mix use_cached (client reads) with uncached (the
+        re-read) loses host-cascade eligibility — an A/B on the r4 rig
+        measured global_4peer collapsing 20k -> 5k checks/s with re-reads
+        merged into the lane, versus ~1/3 of cluster cycles saved.  The
+        LocalBatcher still coalesces concurrent re-read batches."""
+        return await self.s._check_local(reads)
+
     async def _broadcast_peers(
         self, updates: Dict[str, RateLimitReq]
     ) -> None:
@@ -958,8 +977,10 @@ class GlobalManager:
             )
             for r in updates.values()
         ]
+        self.reread_batches += 1
+        self.reread_keys += len(reads)
         try:
-            statuses = await self.s._check_local(reads)
+            statuses = await self._read_statuses(reads)
         except Exception as e:  # noqa: BLE001
             log.error("while broadcasting update to peers: %s", e)
             return
