@@ -117,6 +117,65 @@ def test_tls_cluster_forwarding():
     assert len(owners) == 2, f"expected both peers serving, got {owners}"
 
 
+def test_grpc_optional_client_auth_divergence(caplog):
+    """Pin the DOCUMENTED divergence from the reference (tls.go:140-238):
+    grpc-python cannot request-a-cert-without-requiring-one, so on the
+    gRPC listener the optional modes (request / verify-if-given) do not
+    ask clients for certificates at all — a bare TLS client is served and
+    no client identity exists.  setup_tls must warn about exactly this.
+    The HTTPS gateway implements the optional modes faithfully
+    (test_https_gateway_client_auth); required modes are exact-or-
+    stricter on both listeners."""
+    import logging
+
+    ca_pem, ca_key_pem, _, _ = generate_auto_tls()
+    with tempfile.NamedTemporaryFile(suffix=".pem", delete=False) as caf, \
+            tempfile.NamedTemporaryFile(
+                suffix=".pem", delete=False
+            ) as cakf:
+        caf.write(ca_pem)
+        cakf.write(ca_key_pem)
+
+    async def scenario() -> None:
+        with caplog.at_level(logging.WARNING, logger="gubernator_tpu.tls"):
+            d = Daemon(DaemonConfig(
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                behaviors=fast_test_behaviors(),
+                device=DEV,
+                tls=TLSConfig(
+                    client_auth="request",
+                    ca_file=caf.name, ca_key_file=cakf.name,
+                ),
+            ))
+            await d.start()
+        assert any(
+            "cannot request-without-require" in r.message
+            for r in caplog.records
+        ), "setup_tls must warn about the gRPC optional-auth divergence"
+        try:
+            # Bare client: server-auth TLS only, NO client certificate.
+            # The reference's `request` mode would ask for (and ignore a
+            # missing) cert; here the gRPC listener never asks, and the
+            # request is served — the documented degradation.
+            creds = grpc.ssl_channel_credentials(root_certificates=ca_pem)
+            ch = grpc.aio.secure_channel(d.grpc_address, creds)
+            stub = V1Stub(ch)
+            resp = await stub.GetRateLimits(pb.GetRateLimitsReq(
+                requests=[req_to_pb(RateLimitReq(
+                    name="tls_opt", unique_key="k", hits=1, limit=5,
+                    duration=60_000,
+                ))]
+            ))
+            assert resp.responses[0].error == ""
+            assert resp.responses[0].remaining == 4
+            await ch.close()
+        finally:
+            await d.close()
+
+    asyncio.run(scenario())
+
+
 def test_https_gateway_client_auth():
     """HTTPS gateway client-auth modes (tls_test.go:235-343): a
     require-and-verify gateway rejects bare clients and accepts
