@@ -149,11 +149,11 @@ def test_grpc_optional_client_auth_divergence(caplog):
                 ),
             ))
             await d.start()
-        assert any(
-            "cannot request-without-require" in r.message
-            for r in caplog.records
-        ), "setup_tls must warn about the gRPC optional-auth divergence"
         try:
+            assert any(
+                "cannot request-without-require" in r.message
+                for r in caplog.records
+            ), "setup_tls must warn about the gRPC optional-auth divergence"
             # Bare client: server-auth TLS only, NO client certificate.
             # The reference's `request` mode would ask for (and ignore a
             # missing) cert; here the gRPC listener never asks, and the
