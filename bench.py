@@ -7,7 +7,7 @@ mixed token/leaky bucket, batch of 262144 decisions per step
 point, not a workload property — the service's maximal-merge drains
 feed steps whatever is queued, and per-step launch overhead amortizes
 with batch until HBM bandwidth binds: measured r4, 32k -> ~0.27-0.39B,
-131k -> ~1.1-1.4B, 262k -> ~2.4-2.9B decisions/s (~550GB/s of bucket
+131k -> ~1.1-1.4B, 262k -> ~2.4-3.2B decisions/s (~550GB/s of bucket
 traffic, comfortably under v5e's ~819GB/s); 512k+ flirts with
 saturation and >=1M lanes faulted the chip, so the default stays at
 262144.  State exactness at this batch is asserted by the differential

@@ -227,7 +227,7 @@ class Daemon:
         self.fastpath = FastPath(
             self.service,
             max_inflight=getattr(self.conf, "fastpath_inflight", 1),
-            sparse_limit=getattr(self.conf, "fastpath_sparse", 0),
+            sparse_limit=getattr(self.conf, "fastpath_sparse", 64),
         )
 
         # gRPC server (daemon.go:101-126): both services on one listener.

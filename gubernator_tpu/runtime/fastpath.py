@@ -92,12 +92,17 @@ class _Coalescer:
 
     Adaptive sparse overlap (`sparse_limit` > 0): a drain no bigger than
     `sparse_limit` requests that would otherwise WAIT for the in-flight
-    merge's response sync may instead dispatch on ONE overlap slot — at
-    low load an arrival then costs ~1 device round-trip instead of ~2
-    (the reference's batcher fires its window early when sparse,
+    merge's response sync may instead dispatch on one of OVERLAP_SLOTS
+    overlap slots — at low load an arrival then costs ~1 device
+    round-trip instead of ~2 (A/B'd on the r4 rig: small-batch p50
+    152 -> 82ms; one slot was NOT enough — concurrent small arrivals
+    need a slot each to all dispatch within the current fetch cycle;
+    the reference's batcher fires its window early when sparse,
     peer_client.go:373-446).  Under load drains exceed the limit and the
     strict depth-1 maximal-merge discipline holds (measured monotone
-    1>2>3>4>6 on the tunnel rig — see FastPath below)."""
+    1>2>3>4>6 for big merges — splitting them costs throughput)."""
+
+    OVERLAP_SLOTS = 3
 
     def __init__(self, pool, process, max_inflight: int = 1,
                  sparse_limit: int = 0, size_of=None) -> None:
@@ -106,7 +111,7 @@ class _Coalescer:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self._inflight = asyncio.Semaphore(max_inflight)
-        self._overlap = asyncio.Semaphore(1)
+        self._overlap = asyncio.Semaphore(self.OVERLAP_SLOTS)
         self._sparse_limit = sparse_limit
         self._size_of = size_of or (lambda e: 1)
         self._dispatches: set = set()
@@ -234,16 +239,18 @@ class FastPath:
     exactly like any other single-writer section."""
 
     def __init__(self, service, max_inflight: int = 1,
-                 sparse_limit: int = 0) -> None:
+                 sparse_limit: int = 64) -> None:
         if max_inflight < 1:
             raise ValueError(
                 f"fastpath max_inflight must be >= 1, got {max_inflight}"
             )
         self.s = service
-        # One extra worker backs the sparse-overlap slot, or its merge
+        # Extra workers back the sparse-overlap slots, or their merges
         # would queue behind the in-flight one in this very pool.
         self._pool = ThreadPoolExecutor(
-            max_workers=max_inflight + (1 if sparse_limit > 0 else 0),
+            max_workers=max_inflight + (
+                _Coalescer.OVERLAP_SLOTS if sparse_limit > 0 else 0
+            ),
             thread_name_prefix="tpu-fastlane",
         )
         self._mach = _Coalescer(

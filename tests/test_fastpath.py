@@ -1296,6 +1296,70 @@ def test_mesh_global_engine_routed_multinode():
         c.stop()
 
 
+def test_multinode_store_on_fast_lane():
+    """Store hooks on a 2-node cluster ride the lane on BOTH sides of a
+    forward: the owner's peer-RPC drain seeds/captures into the OWNER's
+    store (per-node persistence, like the reference's per-instance
+    store) and the non-owner's store never sees the key.  (Restart
+    survival itself is pinned by test_store_served_on_fast_lane and
+    test_mesh_engine_store_on_fast_lane.)"""
+    from gubernator_tpu.runtime.store import MockStore
+
+    stores = [MockStore(), MockStore()]
+    # conf_template is shared by all daemons; attach per-daemon stores by
+    # starting with one template and swapping after boot is NOT possible
+    # (store binds at backend construction) — so start two 1-node
+    # clusters and join them manually instead.
+    from gubernator_tpu.core.types import PeerInfo
+
+    cs = []
+    for st in stores:
+        conf = DaemonConfig()
+        conf.store = st
+        cs.append(Cluster.start(1, conf_template=conf))
+    try:
+        d0, d1 = cs[0].daemons[0], cs[1].daemons[0]
+        peers = [
+            PeerInfo(grpc_address=d0.grpc_address),
+            PeerInfo(grpc_address=d1.grpc_address),
+        ]
+        cs[0].run(d0.set_peers(peers), timeout=30)
+        cs[1].run(d1.set_peers(peers), timeout=30)
+
+        cl = V1Client(d0.grpc_address)
+        keys = [f"mk{i}" for i in range(24)]
+        rs = cl.get_rate_limits([
+            RateLimitReq(name="mn", unique_key=k, hits=1, limit=9,
+                         duration=60_000)
+            for k in keys
+        ])
+        assert all(r.error == "" for r in rs)
+        assert all(r.remaining == 8 for r in rs)
+        # Ownership decides WHICH store captured each key.
+        own0 = {
+            k for k in keys
+            if d0.service.get_peer(f"mn_{k}").info().grpc_address
+            == d0.grpc_address
+        }
+        assert own0 and len(own0) < len(keys)  # both nodes own some
+        for k in keys:
+            key = f"mn_{k}"
+            if k in own0:
+                assert key in stores[0].data and key not in stores[1].data
+                assert stores[0].data[key].remaining == 8
+            else:
+                assert key in stores[1].data and key not in stores[0].data
+                assert stores[1].data[key].remaining == 8
+        # Both daemons served their side on the lane.
+        assert d0.fastpath.fallbacks == 0
+        assert d1.fastpath.fallbacks == 0
+        assert d0.fastpath.served > 0 and d1.fastpath.served > 0
+        cl.close()
+    finally:
+        for c in cs:
+            c.stop()
+
+
 def test_mesh_engine_store_on_fast_lane():
     """A mesh daemon with a Store serves GLOBAL lanes on the engine fast
     lane: serve_packed seeds never-seen keys from Store.get (a persisted
