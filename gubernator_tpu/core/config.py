@@ -135,6 +135,11 @@ class DaemonConfig:
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
     peer_discovery_type: str = "none"  # none|static|dns|gossip|k8s|etcd
+    # Ring hash for key placement: "xx" (default), or "fnv1"/"fnv1a" for
+    # placement interop with reference peers (config.go:403-425); the
+    # columnar fast-lane router serves all three (gub_fnv_hashkey_batch).
+    local_picker_hash: str = "xx"
+    region_picker_hash: str = "xx"
     static_peers: List[str] = field(default_factory=list)
     dns_fqdn: str = ""
     dns_poll_interval_s: float = 10.0
@@ -160,10 +165,11 @@ class DaemonConfig:
     fastpath_inflight: int = 1
     # Sparse-overlap threshold (requests): a fast-lane drain at most this
     # big may dispatch on one of 3 overlap slots instead of waiting out
-    # the in-flight merge's response sync.  A/B'd on the r4 rig: halves
-    # small-batch p50 (152 -> 82ms, ~1 fetch cycle) with token-config
-    # throughput unchanged (big drains exceed the limit and keep the
-    # strict depth-1 maximal-merge discipline).  0 disables.
+    # the in-flight merge's response sync.  Re-A/B'd interleaved on the
+    # r5 rig: small-batch p50 156 -> 86ms in both reps (~1 fetch cycle),
+    # token-config throughput within run-to-run noise (big drains exceed
+    # the limit and keep the strict depth-1 maximal-merge discipline).
+    # 0 disables.
     fastpath_sparse: int = 64
 
 
@@ -302,6 +308,8 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         peer_discovery_type=_env(
             "GUBER_PEER_DISCOVERY_TYPE", "static" if static_peers else "none"
         ),
+        local_picker_hash=_env("GUBER_PEER_PICKER_HASH", "xx"),
+        region_picker_hash=_env("GUBER_REGION_PICKER_HASH", "xx"),
         static_peers=static_peers,
         dns_fqdn=_env("GUBER_DNS_FQDN", ""),
         dns_poll_interval_s=_env_float_s("GUBER_DNS_POLL_INTERVAL", 10.0),

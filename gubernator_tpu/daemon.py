@@ -208,6 +208,12 @@ class Daemon:
             device=self.conf.device,
             cache_size=self.conf.cache_size,
             data_center=self.conf.data_center,
+            local_picker_hash=getattr(
+                self.conf, "local_picker_hash", "xx"
+            ),
+            region_picker_hash=getattr(
+                self.conf, "region_picker_hash", "xx"
+            ),
             loader=getattr(self.conf, "loader", None),
             store=getattr(self.conf, "store", None),
             sketch=getattr(self.conf, "sketch", None),

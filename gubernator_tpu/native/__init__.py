@@ -97,6 +97,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
     ]
     lib.gub_xxh64_batch.restype = None
+    lib.gub_fnv_hashkey_batch.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.gub_fnv_hashkey_batch.restype = None
     lib.gub_assign_rounds.argtypes = [
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         ctypes.c_void_p,  # shards (int32*) or None
@@ -175,6 +184,26 @@ def hash_keys(keys) -> np.ndarray:
     blob = b"".join(encoded)
     out = np.empty(n, dtype=np.int64)
     lib.gub_xxh64_batch(blob, offsets, n, out)
+    return out
+
+
+def fnv_hashkey_batch(
+    payload: bytes, cols, variant: str
+) -> Optional[np.ndarray]:
+    """FNV-1/FNV-1a ring hashes of each parsed request's hash key
+    (name + "_" + unique_key), int64 two's-complement view; 0 on errored
+    lanes.  `cols` is a ParsedReqs (its msg_off/msg_len frame table is
+    re-walked).  Keeps the columnar router serving under the reference's
+    fnv placement rings (replicated_hash.go:33) in mixed clusters.
+    Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(cols.n, dtype=np.int64)
+    lib.gub_fnv_hashkey_batch(
+        payload, cols.msg_off, cols.msg_len, cols.n,
+        0 if variant == "fnv1" else 1, out,
+    )
     return out
 
 

@@ -151,7 +151,7 @@ class PeerClient:
         watcher which can miss a short-lived READY."""
         return self._ever_ready
 
-    async def _ensure_ready(self) -> None:
+    async def _ensure_ready(self) -> float:
         """Pre-dial gate: on a channel that has never been READY, wait
         for readiness BEFORE issuing the first RPC (the reference
         connects first for the same reason, peer_client.go:318).  Fails
@@ -160,9 +160,14 @@ class PeerClient:
         dial error.  Any failure here raises PeerNotReadyError — provably
         unsent, since no request has been issued on the channel yet,
         whatever states the channel may have blinked through.  After the
-        first readiness this is a no-op."""
+        first readiness this is a no-op.
+
+        Returns the seconds left of the `batch_timeout_s` budget: the
+        readiness wait and the caller's RPC deadline share ONE budget,
+        so a slow first connect cannot stretch a call to ~2x the
+        configured timeout."""
         if self._ever_ready:
-            return
+            return self.behavior.batch_timeout_s
         ch = self._channel
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.behavior.batch_timeout_s
@@ -187,7 +192,7 @@ class PeerClient:
             state = ch.get_state(try_to_connect=True)
         else:
             self._ever_ready = True
-            return
+            return max(deadline - loop.time(), 0.001)
         # A failed first dial is a peer error like any other: the health
         # check's rolling window must see it even though no RPC was ever
         # issued on the channel.
@@ -302,9 +307,9 @@ class PeerClient:
         self._track_inflight(+1)
         try:
             await self._connect()
-            await self._ensure_ready()
+            budget = await self._ensure_ready()
             out = await self._raw_get_peer_rate_limits(
-                payload, timeout=self.behavior.batch_timeout_s
+                payload, timeout=budget
             )
             self._ever_ready = True
             return out
@@ -326,13 +331,11 @@ class PeerClient:
         self._track_inflight(+1)
         try:
             stub = await self._connect()
-            await self._ensure_ready()
+            budget = await self._ensure_ready()
             req = peers_pb2.UpdatePeerGlobalsReq(
                 globals=[grpc_api.global_to_pb(g) for g in globals_]
             )
-            await stub.UpdatePeerGlobals(
-                req, timeout=self.behavior.batch_timeout_s
-            )
+            await stub.UpdatePeerGlobals(req, timeout=budget)
             self._ever_ready = True
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
@@ -469,12 +472,10 @@ class PeerClient:
         self, reqs: List[RateLimitReq]
     ) -> List[RateLimitResp]:
         stub = await self._connect()
-        await self._ensure_ready()
+        budget = await self._ensure_ready()
         pb_req = peers_pb2.GetPeerRateLimitsReq(
             requests=[grpc_api.req_to_pb(r) for r in reqs]
         )
-        pb_resp = await stub.GetPeerRateLimits(
-            pb_req, timeout=self.behavior.batch_timeout_s
-        )
+        pb_resp = await stub.GetPeerRateLimits(pb_req, timeout=budget)
         self._ever_ready = True
         return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

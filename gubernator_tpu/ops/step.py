@@ -64,6 +64,14 @@ class Resp(NamedTuple):
     # broadcast read path) — no mutation happened; the fast lane's cached
     # duplicate cascade branches on this.
     cached: jax.Array     # bool[B]
+    # POST-step stored Status column (the write-back's n_status): what a
+    # hits=0 re-read of this row would report.  Token status is STICKY —
+    # it differs from the response status on over-more hits, which report
+    # OVER without storing it (algorithms.go:167-195); leaky rows store
+    # UNDER always (status is computed per read, algorithms.go:395-426).
+    # Lets the GLOBAL broadcast derive its rows from the drain's own
+    # response instead of re-running zero-hit reads (global.go:205-250).
+    stored_status: jax.Array  # int32[B]
 
 
 class DeviceBatchJ(NamedTuple):
@@ -369,6 +377,10 @@ def apply_batch_impl(
             ),
         ),
         cached=cached_hit,
+        # Mirrors the write-back's n_status below (kept in sync).
+        stored_status=jnp.where(
+            cached_hit, s_status, sel(te_status, UNDER, 0, 0, 0)
+        ).astype(jnp.int32),
     )
 
     # ==== write back ====================================================
@@ -607,12 +619,12 @@ def apply_batch_packed_impl(
     now: jax.Array,
     ways: int = 8,
 ) -> Tuple[SlotTable, jax.Array]:
-    """apply_batch with the response packed into ONE int64[8, B] array —
-    a single device->host transfer per step instead of eight.  Matters when
+    """apply_batch with the response packed into ONE int64[9, B] array —
+    a single device->host transfer per step instead of nine.  Matters when
     the host link has per-transfer latency (e.g. remote-device tunnels).
 
     Rows: status, limit, remaining, reset_time, persisted, found, stored,
-    cached.
+    cached, stored_status.
     """
     new_table, r = apply_batch_impl(table, batch, now, ways)
     packed = jnp.stack([
@@ -624,6 +636,7 @@ def apply_batch_packed_impl(
         r.found.astype(jnp.int64),
         r.stored.astype(jnp.int64),
         r.cached.astype(jnp.int64),
+        r.stored_status.astype(jnp.int64),
     ])
     return new_table, packed
 
@@ -652,7 +665,7 @@ def apply_batch_packed_q_impl(
     ways: int = 8,
 ) -> Tuple[SlotTable, jax.Array]:
     """Fully packed step: ONE int64[12, B] host->device transfer in, ONE
-    int64[8, B] transfer out.  Per-transfer link latency (remote-device
+    int64[9, B] transfer out.  Per-transfer link latency (remote-device
     tunnels) makes the 12-arrays-in form 12x more expensive; this is the
     single-device analog of the mesh path's pack_grid_batch."""
     return apply_batch_packed_impl(table, unpack_batch_q(q), now, ways)

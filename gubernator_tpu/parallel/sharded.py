@@ -103,10 +103,11 @@ def unpack_grid_batch(q) -> DeviceBatchJ:
 
 def make_sharded_step_packed(mesh, ways: int):
     """Jitted multi-device step over packed transfers:
-    table'[n·S], resp[n, 6, B] = step(table[n·S], batch[12, n, B], now).
+    table'[n·S], resp[n, 9, B] = step(table[n·S], batch[12, n, B], now).
 
     Response row order is apply_batch_packed's: status, limit, remaining,
-    reset_time, persisted, found (one shared packer, ops/step.py:542-568).
+    reset_time, persisted, found, stored, cached, stored_status (one
+    shared packer, ops/step.py).
     """
 
     def _local(table: SlotTable, packed, now):
@@ -124,7 +125,7 @@ def make_sharded_step_packed(mesh, ways: int):
 
 
 def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed [n, 8, B] responses — ONE transfer for all
+    """Host view of packed [n, 9, B] responses — ONE transfer for all
     rounds (fetch_ravel).  Field arrays are [n, B], so (shard, lane)
     positions index directly."""
     from gubernator_tpu.runtime.backend import (

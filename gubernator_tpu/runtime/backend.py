@@ -129,16 +129,24 @@ class PersistenceHost:
         self._seed_missing(keys, hashes, [uniq[k] for k in keys], now)
 
     def _seed_missing(self, keys, hashes, reqs, now: int) -> None:
-        """Seeding core shared by the object path and the fast lane's
-        columnar drains: one residency probe over `hashes` (unsigned),
-        Store.get only for the misses, one bulk upsert.  Caller holds
+        """Object-path seeding: one residency probe over `hashes`
+        (unsigned), then the shared Store-consult core.  Caller holds
         `_lock`."""
+        found = self._found_mask(keys, hashes, now)
+        self._store_seed_misses(hashes, reqs, found, now)
+
+    def _store_seed_misses(self, hashes, reqs, found, now: int):
+        """Store-consult core shared by the object path (probe-derived
+        `found`) and the fast lane's cold-key repair (the step's own
+        `found` column): Store.get for each miss, one bulk upsert of the
+        live items (algorithms.go:45-51 batched).  Caller holds `_lock`.
+        Returns the indices (into the input lists) that were seeded."""
         from gubernator_tpu.runtime.store import item_to_row_fields
 
-        found = self._found_mask(keys, hashes, now)
         rows: List[dict] = []
         row_hashes: List[int] = []
-        for h, r, f in zip(hashes, reqs, found):
+        seeded: List[int] = []
+        for i, (h, r, f) in enumerate(zip(hashes, reqs, found)):
             if f:
                 continue
             item = self.store.get(r)
@@ -146,8 +154,10 @@ class PersistenceHost:
                 continue
             rows.append(item_to_row_fields(item))
             row_hashes.append(h)
+            seeded.append(i)
         if rows:
             self._bulk_upsert(rows, row_hashes, now)
+        return seeded
 
     def _init_write_through(self) -> None:
         """Write-through delivery ordering + keymap-writer state (backend
@@ -753,6 +763,7 @@ def resp_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
             "found": np.asarray(r.found),
             "stored": np.asarray(r.stored),
             "cached": np.asarray(r.cached),
+            "stored_status": np.asarray(r.stored_status),
         }
         for r in round_resps
     ]
@@ -771,6 +782,11 @@ def fetch_ravel(arrs) -> List[np.ndarray]:
         return []
     if len(arrs) == 1:
         return [np.asarray(arrs[0])]
+    # Mixed dtypes would silently promote under concatenate and come back
+    # cast; callers must pack per-dtype groups separately.
+    assert all(a.dtype == arrs[0].dtype for a in arrs), (
+        [a.dtype for a in arrs]
+    )
     import jax.numpy as jnp
 
     flat = jnp.concatenate([a.ravel() for a in arrs])
@@ -786,7 +802,7 @@ def fetch_ravel(arrs) -> List[np.ndarray]:
 
 def _packed_resp_dict(a: np.ndarray) -> Dict[str, np.ndarray]:
     """apply_batch_packed row order -> named host columns; `a` is
-    [8, B] (single table) or [n, 8, B] (grid, leading shard dim)."""
+    [9, B] (single table) or [n, 9, B] (grid, leading shard dim)."""
     sl = (slice(None),) * (a.ndim - 2)
     return {
         "status": a[sl + (0,)],
@@ -797,11 +813,12 @@ def _packed_resp_dict(a: np.ndarray) -> Dict[str, np.ndarray]:
         "found": a[sl + (5,)],
         "stored": a[sl + (6,)],
         "cached": a[sl + (7,)],
+        "stored_status": a[sl + (8,)],
     }
 
 
 def packed_rounds_to_host(round_packed) -> List[Dict[str, np.ndarray]]:
-    """Host view of packed int64[8, B] responses (apply_batch_packed row
+    """Host view of packed int64[9, B] responses (apply_batch_packed row
     order) — ONE transfer for all rounds (fetch_ravel)."""
     return [
         _packed_resp_dict(a) for a in fetch_ravel(list(round_packed))

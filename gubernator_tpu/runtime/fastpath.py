@@ -26,10 +26,13 @@ runtime/service.py) by concatenating their columns before packing.
 Eligibility — anything else falls back to the object path, which remains
 the semantic reference:
   - native library loadable;
-  - a Store / Loader attached stays ON the lane: each drain bulk-probes
-    residency, calls Store.get only for the misses, and captures
-    write-through rows with ONE packed device gather (ticketed
-    on_change delivery, like the object path's batch-boundary fix).
+  - a Store / Loader attached stays ON the lane: residency comes from
+    the step's own `found` column (no pre-step probe fetch — a warm
+    drain pays ONE combined response+capture fetch, storeless parity),
+    Store.get runs only for cold keys, whose drains repair in place
+    (_repair_cold_store_keys), and write-through rows are captured
+    with ONE packed device gather (ticketed on_change delivery, like
+    the object path's batch-boundary fix).
     The SPI itself takes Python objects, so the lane decodes one
     request per UNIQUE key per drain — the only per-key host cost;
     on_change fires once per unique key per DRAIN (coalesced RPCs
@@ -94,13 +97,16 @@ class _Coalescer:
     `sparse_limit` requests that would otherwise WAIT for the in-flight
     merge's response sync may instead dispatch on one of OVERLAP_SLOTS
     overlap slots — at low load an arrival then costs ~1 device
-    round-trip instead of ~2 (A/B'd on the r4 rig: small-batch p50
-    152 -> 82ms; one slot was NOT enough — concurrent small arrivals
-    need a slot each to all dispatch within the current fetch cycle;
-    the reference's batcher fires its window early when sparse,
-    peer_client.go:373-446).  Under load drains exceed the limit and the
-    strict depth-1 maximal-merge discipline holds (measured monotone
-    1>2>3>4>6 for big merges — splitting them costs throughput)."""
+    round-trip instead of ~2.  Re-A/B'd interleaved on the r5 rig:
+    small-batch p50 156 -> 86ms in both reps, token throughput within
+    run-to-run noise (52.0k vs 52.0k, 46.4k vs 51.6k checks/s).  One
+    slot was NOT enough — concurrent small arrivals need a slot each to
+    all dispatch within the current fetch cycle (the r4 artifact's
+    "no win" note was measured with a single slot); the reference's
+    batcher fires its window early when sparse, peer_client.go:373-446.
+    Under load drains exceed the limit and the strict depth-1
+    maximal-merge discipline holds (measured monotone 1>2>3>4>6 for big
+    merges — splitting them costs throughput)."""
 
     OVERLAP_SLOTS = 3
 
@@ -334,6 +340,14 @@ class FastPath:
         if routed and not self._can_route():
             self.fallbacks += 1
             return None
+        if routed and len(self.s.local_picker.ring_arrays()[2]) == 0:
+            # Empty ring: fall back BEFORE any metric side effects so the
+            # object path (which re-runs validation and increments the
+            # same counters) can't double-count.  There is no await
+            # between here and _serve_routed's ring read, so the router
+            # below never sees an empty ring.
+            self.fallbacks += 1
+            return None
         cols = native.parse_reqs(payload)
         if cols is None:
             self.fallbacks += 1
@@ -453,10 +467,12 @@ class FastPath:
     async def _serve_cols(
         self, payload, cols, is_greg, ge, gd, use_cached=None
     ) -> Tuple[np.ndarray, ...]:
-        """Submit columns to the coalescing batcher; returns the four
-        response arrays (status, limit, remaining, reset_time).  `payload`
-        is the raw wire bytes the columns were spliced from — the
-        persistence SPI decodes per-unique-key requests from it."""
+        """Submit columns to the coalescing batcher; returns the seven
+        response arrays (status, limit, remaining, reset_time, stored,
+        stored_status, cap_ok — the last three feed the GLOBAL broadcast
+        capture).  `payload` is the raw wire bytes the columns were
+        spliced from — the persistence SPI decodes per-unique-key
+        requests from it."""
         return await self._mach.do(_Entry(
             payload=payload,
             cols=cols,
@@ -518,7 +534,8 @@ class FastPath:
             mgr.queue_hit(dc_replace(req, hits=total))
 
     def _queue_global_updates(self, payload, cols, is_global,
-                              owned=None, peer_rpc=False) -> None:
+                              owned=None, peer_rpc=False,
+                              capture=None) -> None:
         """Queue owner-side broadcast updates for GLOBAL lanes — GREGORIAN-
         errored lanes included: the reference QueueUpdates before the
         algorithm runs (gubernator.go:617-619), so with last-write-wins
@@ -537,7 +554,30 @@ class FastPath:
         key string like the object path's routing; Gregorian errors on
         the ROUTED path keep their true hash in `cols` (only
         serve_local's subset copy was zeroed), so they group with the
-        valid lanes — same last-write-wins outcome either way."""
+        valid lanes — same last-write-wins outcome either way.
+
+        `capture` = (stored_status, stored, reset, limit, cap_ok)
+        full-size response columns from this drain: each queued update
+        carries the post-step stored state of its LAST arrival, which the
+        broadcast ships directly instead of re-running a zero-hit read —
+        equal by construction to global.go:205-250's re-read of a bucket
+        row (token reports the sticky stored status; leaky always
+        re-reads UNDER; reset/remaining are the post-step stored values;
+        a lane whose request errored re-captures the error, which the
+        broadcast skips exactly as it skips a failed re-read).  A capture
+        is kept ONLY when `cap_ok` marks the arrival as its key's last
+        mutating occurrence across the WHOLE merged drain (computed in
+        _process over every coalesced RPC — a later occurrence, even from
+        another concurrent call, moves the row past the capture, and the
+        flush-time re-read would then apply the queued request's now
+        stale params to the newer row, a reference quirk the re-read
+        fallback preserves exactly; sketch lanes never reach _process's
+        machinery merge, so their cap_ok stays False).  Later DRAINS
+        degrade captures via _touch_captures.  The only intended
+        divergences from flush-time
+        re-reads: sub-window leaky time-regen (zero under a frozen
+        clock) and no resurrection of keys evicted between drain and
+        flush."""
         idx = np.flatnonzero(is_global)
         if not len(idx):
             return
@@ -578,8 +618,67 @@ class FastPath:
                 if cur is None or i > cur[0]:
                     best[key] = (i, req)
         mgr = self.s.global_mgr
-        for _, req in best.values():
-            mgr.queue_update(req)
+        if capture is None:
+            for _, req in best.values():
+                mgr.queue_update(req)
+            return
+        from gubernator_tpu.core.types import RateLimitResp, Status
+
+        sst, sto, rst, lm, cap_ok = capture
+        for i, req in best.values():
+            if cols.err[i] != 0:
+                # Errored last arrival: the re-read would fail the same
+                # way and broadcast nothing — capture a sentinel error so
+                # the broadcast skips this key (last-write-wins cancel,
+                # immune to later mutations: the QUEUED params stay
+                # errored).
+                st: Optional[RateLimitResp] = RateLimitResp(
+                    error="capture: errored lane"
+                )
+            elif not cap_ok[i]:
+                st = None  # a later occurrence moved the row — re-read
+            elif int(cols.behavior[i]) & int(Behavior.RESET_REMAINING):
+                # The flush-time re-read of a RESET_REMAINING request
+                # re-runs the reset (algorithms.go:78-90 precedes the
+                # hits==0 early-out) — a mutating read the capture
+                # cannot represent.
+                st = None
+            elif int(cols.algo[i]) == 1 and int(sto[i]) > int(
+                cols.burst[i] if cols.burst[i] != 0 else cols.limit[i]
+            ):
+                # Leaky row overfilled past burst (negative hits): the
+                # next read — including the flush re-read — clamps and
+                # WRITES remaining back to burst (algorithms.go:372-376).
+                # Another mutating read; keep it.
+                st = None
+            else:
+                st = RateLimitResp(
+                    status=Status(int(sst[i])),
+                    limit=int(lm[i]),
+                    remaining=int(sto[i]),
+                    reset_time=int(rst[i]),
+                )
+            mgr.queue_update(req, st)
+
+    def _touch_captures(self, cols, sk=None, eng=None) -> None:
+        """Degrade stale captured GLOBAL broadcast rows for every key
+        this drain mutated on the machinery table (a non-GLOBAL request
+        must not let a pending capture ship pre-mutation state — the
+        re-read fallback then sees the post-mutation row, exactly like
+        the reference's flush-time read).  Near-free while no captures
+        are pending; lanes that re-queue an update below simply
+        re-capture fresh state (touch runs first)."""
+        mgr = self.s.global_mgr
+        if not mgr._pending_h:
+            return
+        mask = cols.err == 0
+        if sk is not None:
+            mask &= ~sk
+        # Engine lanes stay in the set: they mutate the engine's own
+        # tables, but engine services never create RPC captures, so
+        # touching them is a no-op — not worth a mask.
+        if mask.any():
+            mgr.touch_hashes(cols.hash[mask])
 
     def _queue_multiregion(self, payload, cols, idx) -> None:
         """Queue owner-side MULTI_REGION hits for the request indices
@@ -618,6 +717,12 @@ class FastPath:
         out_lim = np.zeros(n, dtype=np.int64)
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
+        # Post-step stored columns (machinery lanes only — sketch/engine
+        # lanes never feed the RPC broadcast capture, so their cap_ok
+        # stays False).
+        stored = np.zeros(n, dtype=np.int64)
+        stored_st = np.zeros(n, dtype=np.int64)
+        cap_ok = np.zeros(n, dtype=bool)
         loop = asyncio.get_running_loop()
 
         async def run_sketch() -> None:
@@ -648,7 +753,7 @@ class FastPath:
 
         async def run_exact() -> None:
             sub = cols.subset(ex_idx)
-            st, lm, rem, rst = await self._serve_cols(
+            st, lm, rem, rst, sto, sst, cok = await self._serve_cols(
                 payload, sub, is_greg[ex_idx], ge[ex_idx], gd[ex_idx],
                 use_cached=(
                     use_cached[ex_idx] if use_cached is not None else None
@@ -658,6 +763,9 @@ class FastPath:
             out_lim[ex_idx] = lm
             remaining[ex_idx] = rem
             reset[ex_idx] = rst
+            stored[ex_idx] = sto
+            stored_st[ex_idx] = sst
+            cap_ok[ex_idx] = cok
 
         tasks = []
         if len(sk_idx):
@@ -667,7 +775,7 @@ class FastPath:
         if len(ex_idx):
             tasks.append(run_exact())
         await asyncio.gather(*tasks)
-        return status, out_lim, remaining, reset
+        return status, out_lim, remaining, reset, stored, stored_st, cap_ok
 
     def _engine_process(self, entries) -> List[Tuple[np.ndarray, ...]]:
         """Merged columnar serving for node-owned GLOBAL lanes on the
@@ -820,8 +928,10 @@ class FastPath:
             eng = is_global & (cols.err == 0)
             if not eng.any():
                 eng = None
-        status, limit, remaining, reset = await self._serve_split(
-            payload, cols, is_greg, ge, gd, None, sk, eng
+        status, limit, remaining, reset, stored, stored_st, cap_ok = (
+            await self._serve_split(
+                payload, cols, is_greg, ge, gd, None, sk, eng
+            )
         )
         if eng is not None:
             # Metric parity: the object path's routing counts engine
@@ -829,12 +939,14 @@ class FastPath:
             self.s.metrics.getratelimit_counter.labels("global").inc(
                 int(eng.sum())
             )
+        self._touch_captures(cols, sk, eng)
         if is_global.any() and not use_engine:
             # With a collective engine, GLOBAL lanes (errored included)
             # belong to the engine path on the object flow — the RPC
             # update manager is never consulted.
             self._queue_global_updates(
-                payload, cols, is_global, peer_rpc=peer_rpc
+                payload, cols, is_global, peer_rpc=peer_rpc,
+                capture=(stored_st, stored, reset, limit, cap_ok),
             )
         mr = (cols.behavior & _MULTI_REGION) != 0
         if mr.any():
@@ -852,12 +964,16 @@ class FastPath:
         )
 
     def _can_route(self) -> bool:
-        """Columnar routing needs the ring hash to equal the device
-        fingerprint hash (XXH64 of the hash-key string) so the C++ parse
-        output drives the owner lookup directly."""
+        """Columnar routing serves every selectable ring hash: xx rings
+        drive the owner lookup straight from the C++ parse fingerprint
+        (XXH64 of the hash-key string); fnv1/fnv1a rings — placement
+        interop with mixed reference/tpu clusters
+        (replicated_hash.go:33) — get a vectorized second hash column
+        from gub_fnv_hashkey_batch."""
+        from gubernator_tpu.core.hashing import fnv1_64, fnv1a_64
         from gubernator_tpu.net.replicated_hash import xx_64
 
-        return self.s.local_picker.hash_fn is xx_64
+        return self.s.local_picker.hash_fn in (xx_64, fnv1_64, fnv1a_64)
 
     async def _serve_routed(
         self, payload: bytes, cols, n: int, is_global, sk
@@ -875,10 +991,25 @@ class FastPath:
         path's ownership-retry loop per request."""
         picker = self.s.local_picker
         ring, ring_idx, peers = picker.ring_arrays()
-        if len(peers) == 0:
-            self.fallbacks += 1
-            return None  # type: ignore[return-value]
-        h_u = cols.hash.view(np.uint64)
+        # check_raw gated on a non-empty ring with no await in between;
+        # a fallback here would double-count the validation metrics the
+        # caller already incremented, so the invariant must hold.
+        assert peers, "check_raw gates on a non-empty ring"
+        from gubernator_tpu.net.replicated_hash import xx_64
+
+        if picker.hash_fn is xx_64:
+            h_route = cols.hash
+        else:
+            # fnv1/fnv1a interop ring (_can_route admitted it): hash the
+            # spliced hash-key bytes with the ring's own function —
+            # placement stays identical to a reference node's.
+            from gubernator_tpu.core.hashing import fnv1_64
+
+            h_route = native.fnv_hashkey_batch(
+                payload, cols,
+                "fnv1" if picker.hash_fn is fnv1_64 else "fnv1a",
+            )
+        h_u = h_route.view(np.uint64)
         slot = np.searchsorted(ring, h_u, side="left")
         slot[slot == len(ring)] = 0
         owner = ring_idx[slot]  # peer index per request
@@ -896,6 +1027,9 @@ class FastPath:
         out_lim = np.zeros(n, dtype=np.int64)
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
+        stored = np.zeros(n, dtype=np.int64)
+        stored_st = np.zeros(n, dtype=np.int64)
+        cap_ok = np.zeros(n, dtype=bool)
         errs: List[bytes] = [b""] * n
         metas: List[bytes] = [b""] * n
 
@@ -918,7 +1052,7 @@ class FastPath:
                 )
                 if not sub_eng.any():
                     sub_eng = None
-            st, lm, rem, rst = await self._serve_split(
+            st, lm, rem, rst, sto, sst, cok = await self._serve_split(
                 payload, sub, is_greg, ge, gd, glob_cached[idx], sub_sk,
                 sub_eng,
             )
@@ -926,6 +1060,10 @@ class FastPath:
             out_lim[idx] = lm
             remaining[idx] = rem
             reset[idx] = rst
+            stored[idx] = sto
+            stored_st[idx] = sst
+            cap_ok[idx] = cok
+            self._touch_captures(sub, sub_sk, sub_eng)
             sub_errs = self._error_strings(sub, err_extra)
             for j, i in enumerate(idx):
                 if sub_errs[j]:
@@ -1063,7 +1201,8 @@ class FastPath:
                 # when no collective engine owns replication (the engine
                 # broadcasts through sync + the _engine_synced bridge).
                 self._queue_global_updates(
-                    payload, cols, is_global, owned=owned
+                    payload, cols, is_global, owned=owned,
+                    capture=(stored_st, stored, reset, out_lim, cap_ok),
                 )
 
         mr = (cols.behavior & _MULTI_REGION) != 0
@@ -1116,16 +1255,87 @@ class FastPath:
                     cur[2] = cap
         return uniq
 
-    def _seed_store_locked(self, backend, uniq, now: int) -> None:
-        """Bulk Store.get seeding for a drain's unique keys (backend lock
-        held) via the shared probe-miss/get/upsert core
-        (PersistenceHost._seed_missing; algorithms.go:45-51 batched)."""
-        backend._seed_missing(
-            [v[0] for v in uniq.values()],
-            [int(np.int64(fp).view(np.uint64)) for fp in uniq],
-            [v[1] for v in uniq.values()],
-            now,
+    def _repair_cold_store_keys(
+        self, backend, uniq, foundv, h, cols_d, sh_all, n_shards, B,
+        now_ms, out_arrays,
+    ):
+        """Post-step Store.get for COLD keys (backend lock held, response
+        already fetched): the step's `found` column replaces the pre-step
+        residency probe — a warm store drain pays no probe fetch at all.
+
+        A key whose first occurrence missed (`found` False: absent or
+        expired, exactly the probe's liveness test) consults the Store
+        (algorithms.go:45-51).  Live store state REPAIRS the drain: the
+        store row replaces the fresh bucket the step created (load_rows
+        overwrites in place on key match — the fresh row's decrements are
+        discarded), every occurrence of the key re-runs on the seeded
+        row, and the re-run's responses overwrite the originals — the
+        final row and responses are bit-identical to the object path's
+        seed-then-step.  The optimistic capture pre-dates the repair, so
+        the caller refetches it (packed here with the repair responses:
+        a COLD drain pays 2 fetches, matching the old probe path; warm
+        drains pay 1).  The lone divergence from seed-then-step: under
+        full-bucket insert pressure the fresh insert or the repair upsert
+        may each go transient — the same acceptable-loss corner every
+        insert path shares (architecture.md:5-11).
+
+        Returns None when nothing needed repair, else (new capture
+        token, its prefetched int host chunks)."""
+        from gubernator_tpu.runtime.backend import (
+            _packed_resp_dict,
+            fetch_ravel,
         )
+
+        uq, first = np.unique(h, return_index=True)
+        fidx = dict(zip(uq.tolist(), first.tolist()))
+        fps = list(uniq.keys())
+        seeded = backend._store_seed_misses(
+            [int(np.int64(fp).view(np.uint64)) for fp in fps],
+            [uniq[fp][1] for fp in fps],
+            [bool(foundv[fidx[fp]]) for fp in fps],
+            now_ms,
+        )
+        if not seeded:
+            return None
+        rep_fps = [fps[i] for i in seeded]
+        R = np.flatnonzero(np.isin(h, np.array(rep_fps, dtype=np.int64)))
+        r_sh = sh_all[R]
+        rrnd, rlane, rn = native.assign_rounds(
+            h[R], r_sh if n_shards > 1 else None, n_shards, B
+        )
+        rvals = {"key_hash": h[R]}
+        rvals.update({k: v[R] for k, v in cols_d.items()})
+        r_rounds, r_order, r_bounds = _build_rounds(
+            rvals, rrnd, rlane, r_sh, rn, n_shards, B
+        )
+        r_resps = backend._dispatch_rounds_locked(r_rounds)
+        cap_fps = np.array(
+            [fp for fp, v in uniq.items() if v[2] is not None],
+            dtype=np.int64,
+        )
+        cap_token = backend._gather_rows_dispatch(cap_fps, now_ms)
+        cap_ints = backend._gather_rows_int_arrays(cap_token)
+        hosts = fetch_ravel(list(r_resps) + cap_ints)
+        nr = len(r_resps)
+        rhost = [_packed_resp_dict(a) for a in hosts[:nr]]
+        (status, out_lim, remaining, reset, stored, cachedv,
+         stored_st) = out_arrays
+        for r_idx in range(rn):
+            sub = r_order[r_bounds[r_idx]:r_bounds[r_idx + 1]]
+            sel = R[sub]
+            hr = rhost[r_idx]
+            if n_shards > 1:
+                idx = (r_sh[sub], rlane[sub])
+            else:
+                idx = (rlane[sub],)
+            status[sel] = hr["status"][idx]
+            out_lim[sel] = hr["limit"][idx]
+            remaining[sel] = hr["remaining"][idx]
+            reset[sel] = hr["reset_time"][idx]
+            stored[sel] = hr["stored"][idx]
+            cachedv[sel] = hr["cached"][idx]
+            stored_st[sel] = hr["stored_status"][idx]
+        return cap_token, hosts[nr:]
 
     def _build_captured(self, uniq, cap_fps, a, rf) -> list:
         """CacheItems from the packed gather columns (GATHER_ROW_FIELDS
@@ -1283,6 +1493,8 @@ class FastPath:
         reset = np.zeros(n, dtype=np.int64)
         stored = np.zeros(n, dtype=np.int64)
         cachedv = np.zeros(n, dtype=np.int64)
+        stored_st = np.zeros(n, dtype=np.int64)
+        foundv = np.zeros(n, dtype=np.int64)
 
         def gather(host) -> None:
             for r_idx in range(n_rounds):
@@ -1298,6 +1510,8 @@ class FastPath:
                 reset[sel] = hr["reset_time"][idx]
                 stored[sel] = hr["stored"][idx]
                 cachedv[sel] = hr["cached"][idx]
+                stored_st[sel] = hr["stored_status"][idx]
+                foundv[sel] = hr["found"][idx]
 
         if plan is None and not do_store:
             # Plain merge: dispatch under the backend lock, sync outside
@@ -1315,18 +1529,17 @@ class FastPath:
             # write-back itself needs no response sync: the replay already
             # produced every response, and dispatch order serializes it.
             #
-            # Store drains take this branch too: seeding's probe must be
-            # read INSIDE the lock (a concurrent insert between probe and
-            # upsert would be overwritten by stale store state), and the
-            # write-through capture must be DISPATCHED inside it (pinning
-            # the post-step table version) — but the capture's fetch and
-            # on_change delivery happen outside, in ticket order.
+            # Store drains take this branch too, with NO pre-step
+            # residency probe: the step itself answers residency through
+            # its `found` column, so a warm drain pays ONE combined
+            # response+capture fetch — storeless parity — instead of the
+            # probe fetch + combined fetch it used to (algorithms.go:45-51
+            # consults the store only on cache miss; misses repair below).
+            # The lock is held through the fetch: a cold key was served
+            # from a FRESH row that the repair replaces, and no other
+            # drain may observe the interim state.
             cap_token = wt_seq = None
             with backend._lock:
-                if do_store:
-                    self._seed_store_locked(
-                        backend, uniq, backend.clock.millisecond_now()
-                    )
                 resps = backend._dispatch_rounds_locked(rounds)
                 if plan is not None:
                     host = to_host(resps)
@@ -1334,6 +1547,7 @@ class FastPath:
                     wb = _run_cascade(
                         plan, h, hits, lim, dur, algo, burst,
                         status, out_lim, remaining, reset, stored, cachedv,
+                        stored_st,
                     )
                     if wb is not None:
                         (wb_h, wb_hits, wb_lim, wb_dur, wb_algo,
@@ -1362,37 +1576,51 @@ class FastPath:
                         )
                         backend._dispatch_rounds_locked(wb_rounds)
                 if do_store:
-                    cap_fps = np.array(
-                        [fp for fp, v in uniq.items() if v[2] is not None],
-                        dtype=np.int64,
-                    )
-                    cap_token = backend._gather_rows_dispatch(
-                        cap_fps, backend.clock.millisecond_now()
-                    )
-                    wt_seq = backend._wt_ticket()
-            if do_store:
-                captured: list = []
-                try:
                     from gubernator_tpu.runtime.backend import (
                         _packed_resp_dict,
                         fetch_ravel,
                     )
 
-                    # ONE packed round-trip fetches the responses AND the
-                    # capture's int columns together; remaining_f (its own
-                    # dtype) rides a second trip only when a leaky row can
-                    # have been captured.  A store drain thus costs 2-3
-                    # fetch cycles total (seed probe + this) vs 1
-                    # storeless.
+                    now_ms = backend.clock.millisecond_now()
+                    cap_fps = np.array(
+                        [fp for fp, v in uniq.items() if v[2] is not None],
+                        dtype=np.int64,
+                    )
+                    # Optimistic capture: dispatched with the step so the
+                    # warm path fetches response + capture in ONE
+                    # round-trip; a repair below re-dispatches it.
+                    cap_token = backend._gather_rows_dispatch(
+                        cap_fps, now_ms
+                    )
                     cap_ints = backend._gather_rows_int_arrays(cap_token)
                     if plan is None:
                         hosts = fetch_ravel(list(resps) + cap_ints)
                         nr = len(resps)
-                        host = [_packed_resp_dict(h) for h in hosts[:nr]]
+                        host = [_packed_resp_dict(hh) for hh in hosts[:nr]]
                         gather(host)
                         int_hosts = hosts[nr:]
                     else:
                         int_hosts = fetch_ravel(cap_ints)
+                    rep = self._repair_cold_store_keys(
+                        backend, uniq, foundv, h, dict(
+                            hits=hits, limit=lim, duration=dur, algo=algo,
+                            burst=burst, reset_remaining=reset_remaining,
+                            is_greg=is_greg, greg_expire=ge,
+                            greg_duration=gd, use_cached=use_cached,
+                        ),
+                        sh_all, n_shards, B, now_ms,
+                        (status, out_lim, remaining, reset, stored,
+                         cachedv, stored_st),
+                    )
+                    if rep is not None:
+                        # Rows changed under the optimistic capture —
+                        # refetch it (packed with the repair responses
+                        # inside _repair_cold_store_keys).
+                        cap_token, int_hosts = rep
+                    wt_seq = backend._wt_ticket()
+            if do_store:
+                captured: list = []
+                try:
                     rf_hosts = (
                         fetch_ravel(
                             backend._gather_rows_rf_arrays(cap_token)
@@ -1426,7 +1654,26 @@ class FastPath:
             cache_hits=t.cache_hits,
         ))
 
-        # Split back per entry.
+        # GLOBAL broadcast capture validity, judged over the WHOLE merged
+        # drain (entries are concurrent RPCs; a per-entry view would miss
+        # another RPC's later occurrence of the same key): a lane may
+        # capture only if it is its key's LAST mutating occurrence in the
+        # merge.  Judged here — not at queue time — because entries queue
+        # their updates in COMPLETION order (remote forwards differ in
+        # latency), so a stale earlier occurrence could otherwise
+        # overwrite a fresh capture; with this mask it degrades to
+        # (req, None) instead, and the flush re-reads.  h == 0 lanes
+        # (errored) mutate nothing and never capture.
+        cap_ok = np.zeros(n, dtype=bool)
+        mut_idx = np.flatnonzero(h != 0)
+        if len(mut_idx):
+            last_of: Dict[int, int] = {}
+            for j in mut_idx:
+                last_of[int(h[j])] = int(j)
+            cap_ok[list(last_of.values())] = True
+
+        # Split back per entry (stored/stored_status/cap_ok feed the
+        # GLOBAL broadcast capture; see _queue_global_updates).
         outs: List[Tuple[np.ndarray, ...]] = []
         off = 0
         for e in entries:
@@ -1434,6 +1681,8 @@ class FastPath:
             outs.append((
                 status[off:off + k], out_lim[off:off + k],
                 remaining[off:off + k], reset[off:off + k],
+                stored[off:off + k], stored_st[off:off + k],
+                cap_ok[off:off + k],
             ))
             off += k
         return outs
@@ -1587,7 +1836,8 @@ def _plan_cascade(h, hits, reset_remaining, is_greg, lim, dur, algo, burst,
 
 
 def _run_cascade(plan, h, hits, lim, dur, algo, burst,
-                 status, out_lim, remaining, reset, stored, cachedv):
+                 status, out_lim, remaining, reset, stored, cachedv,
+                 stored_st=None):
     """Replay each cascade group's occurrences on host, writing their
     responses in place, and build the effective write-back columns.
 
@@ -1658,6 +1908,12 @@ def _run_cascade(plan, h, hits, lim, dur, algo, burst,
             out_lim[i] = lim0
             remaining[i] = rr
             reset[i] = reset0 + (r0 - rr) * rate_i if leaky else reset0
+        # Post-replay stored columns (the GLOBAL broadcast capture reads
+        # the LAST occurrence): running remaining, and the sticky token
+        # status st0 with replay flips applied (leaky stores UNDER).
+        stored[occ] = r
+        if stored_st is not None:
+            stored_st[occ] = 0 if leaky else st0
 
         def wb_lane(h_val: int) -> None:
             wb_h.append(int(h[fi]))

@@ -38,6 +38,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from gubernator_tpu.core import clock as clock_mod
 from gubernator_tpu.core.config import Config, MAX_BATCH_SIZE
 from gubernator_tpu.core.types import (
@@ -119,13 +121,25 @@ class Service:
             )
         self._inflight_checks = 0
         self._peer_credentials = peer_credentials
-        hash_fn = HASH_FUNCTIONS[self.cfg.local_picker_hash]
+
+        def picker_hash(name: str, which: str):
+            # Named error over a bare KeyError (config.go:403-425
+            # validates the same knob).
+            try:
+                return HASH_FUNCTIONS[name]
+            except KeyError:
+                raise ValueError(
+                    f"invalid {which} picker hash {name!r}; choose one "
+                    f"of {sorted(HASH_FUNCTIONS)}"
+                ) from None
+
+        hash_fn = picker_hash(self.cfg.local_picker_hash, "local")
         self.local_picker: ReplicatedConsistentHash[PeerClient] = (
             ReplicatedConsistentHash(hash_fn)
         )
         self.region_picker: RegionPicker[PeerClient] = RegionPicker(
             ReplicatedConsistentHash(
-                HASH_FUNCTIONS[self.cfg.region_picker_hash]
+                picker_hash(self.cfg.region_picker_hash, "region")
             )
         )
         self._peer_lock = asyncio.Lock()
@@ -496,8 +510,36 @@ class Service:
                     out[i] = sk_resps[j]
                 for j, i in enumerate(ex_idx):
                     out[i] = ex_resps[j]
+                self._touch_global_captures(
+                    [reqs[i] for i in ex_idx],
+                    [use_cached[i] for i in ex_idx] if use_cached else None,
+                )
                 return out  # type: ignore[return-value]
-        return await self._local_batcher.check(reqs, use_cached)
+        resps = await self._local_batcher.check(reqs, use_cached)
+        self._touch_global_captures(reqs, use_cached)
+        return resps
+
+    def _touch_global_captures(
+        self,
+        reqs: Sequence[RateLimitReq],
+        use_cached: Optional[Sequence[bool]] = None,
+    ) -> None:
+        """Object-path mutations must degrade any stale captured GLOBAL
+        broadcast rows for the touched keys (GlobalManager.touch_hashes).
+        No-op unless captures are pending."""
+        if not self.global_mgr._pending_h or not reqs:
+            return
+        from gubernator_tpu.core.hashing import bulk_key_hash64
+
+        keys = [
+            r.hash_key()
+            for r, cached in zip(
+                reqs, use_cached or [False] * len(reqs)
+            )
+            if not cached
+        ]
+        if keys:
+            self.global_mgr.touch_hashes(bulk_key_hash64(keys))
 
     async def _forward(
         self, peer: PeerClient, req: RateLimitReq, key: str
@@ -826,7 +868,18 @@ class GlobalManager:
         self.batch_limit = cfg.global_batch_limit
         self.timeout_s = cfg.global_timeout_s
         self._hits: Dict[str, RateLimitReq] = {}
-        self._updates: Dict[str, RateLimitReq] = {}
+        # key -> (req, captured status | None).  A captured status is the
+        # post-step stored state from the drain that queued it — broadcast
+        # directly, no zero-hit re-read needed.  None falls back to the
+        # re-read (object path, engine bridge).
+        self._updates: Dict[
+            str, Tuple[RateLimitReq, Optional[RateLimitResp]]
+        ] = {}
+        # Device-fingerprint hash -> key, for entries holding a captured
+        # status; lets mutation paths degrade a capture that went stale
+        # (touch_hashes) without decoding keys.
+        self._pending_h: Dict[int, str] = {}
+        self._pending_arr: Optional[np.ndarray] = None
         self._hits_event = asyncio.Event()
         self._updates_event = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
@@ -860,18 +913,73 @@ class GlobalManager:
             self._hits[key] = dc_replace(r)
         self._hits_event.set()
 
-    def queue_update(self, r: RateLimitReq) -> None:
+    def queue_update(
+        self, r: RateLimitReq, status: Optional[RateLimitResp] = None
+    ) -> None:
         """Record an owner-side status change to broadcast
-        (global.go:167-191; last write per key wins)."""
-        self._updates[r.hash_key()] = r
+        (global.go:167-191; last write per key wins).
+
+        `status` is the drain's own post-step stored state for the key —
+        when supplied, the broadcast uses it directly instead of running
+        the zero-hit re-read of global.go:205-250 (equivalent by
+        construction: a GLOBAL-cleared hits=0 read of a bucket row
+        reports exactly the post-step stored status/remaining/reset; see
+        ops.step.Resp.stored_status).  Callers that cannot capture pass
+        None and keep the re-read."""
+        key = r.hash_key()
+        self._updates[key] = (r, status)
+        if status is not None:
+            from gubernator_tpu.core.hashing import key_hash64
+
+            h = int(np.uint64(key_hash64(key)).view(np.int64))
+            if self._pending_h.get(h) != key:
+                self._pending_h[h] = key
+                self._pending_arr = None
         self._updates_event.set()
+
+    def touch_hashes(self, hashes: np.ndarray) -> None:
+        """Degrade captured updates whose key a later drain mutated
+        WITHOUT re-queueing (a non-GLOBAL request on the same key): the
+        broadcast must not ship the stale capture, so the entry falls
+        back to the zero-hit re-read — which sees the post-mutation
+        state, exactly like the reference's flush-time read.  Called by
+        every machinery mutation path with the drained int64 fingerprint
+        column; near-free while no captures are pending.
+
+        Concurrent-drain caveat: with overlapped drains a capture can be
+        queued after the touch of a later-completing drain and survive
+        one window stale — bounded by GLOBAL's eventual consistency (the
+        reference's own broadcast value is stale by its flush+network
+        delay)."""
+        if not self._pending_h:
+            return
+        if self._pending_arr is None:
+            self._pending_arr = np.fromiter(
+                self._pending_h.keys(), dtype=np.int64,
+                count=len(self._pending_h),
+            )
+        hit = np.isin(self._pending_arr, hashes)
+        if not hit.any():
+            return
+        for h in self._pending_arr[hit]:
+            key = self._pending_h.pop(int(h), None)
+            if key is None:
+                continue
+            cur = self._updates.get(key)
+            if cur is not None and cur[1] is not None:
+                self._updates[key] = (cur[0], None)
+        self._pending_arr = None
 
     def _take_hits(self) -> Dict[str, RateLimitReq]:
         hits, self._hits = self._hits, {}
         return hits
 
-    def _take_updates(self) -> Dict[str, RateLimitReq]:
+    def _take_updates(
+        self,
+    ) -> Dict[str, Tuple[RateLimitReq, Optional[RateLimitResp]]]:
         updates, self._updates = self._updates, {}
+        self._pending_h.clear()
+        self._pending_arr = None
         return updates
 
     async def _run_async_hits(self) -> None:
@@ -955,43 +1063,62 @@ class GlobalManager:
         return await self.s._check_local(reads)
 
     async def _broadcast_peers(
-        self, updates: Dict[str, RateLimitReq]
+        self,
+        updates: Dict[str, Tuple[RateLimitReq, Optional[RateLimitResp]]],
     ) -> None:
-        """Re-read each updated status (hits=0, GLOBAL cleared to avoid
-        re-queueing) and push to every non-owner peer (global.go:205-250)."""
+        """Push each updated status to every non-owner peer
+        (global.go:205-250).  Entries whose drain captured the post-step
+        stored state broadcast it directly; the rest re-read it (hits=0,
+        GLOBAL cleared to avoid re-queueing) on the object path."""
         from dataclasses import replace as dc_replace
 
         globals_: List[UpdatePeerGlobal] = []
-        # Clear GLOBAL (avoid re-queueing a broadcast, global.go:214-215)
-        # AND MULTI_REGION (a zero-hit status read must not wake the
-        # cross-region sender).
-        reads = [
-            dc_replace(
-                r,
-                hits=0,
-                behavior=Behavior(
-                    int(r.behavior)
-                    & ~int(Behavior.GLOBAL)
-                    & ~int(Behavior.MULTI_REGION)
-                ),
-            )
-            for r in updates.values()
-        ]
-        self.reread_batches += 1
-        self.reread_keys += len(reads)
-        try:
-            statuses = await self._read_statuses(reads)
-        except Exception as e:  # noqa: BLE001
-            log.error("while broadcasting update to peers: %s", e)
-            return
-        for r, status in zip(reads, statuses):
-            if status.error:
-                continue
-            globals_.append(
-                UpdatePeerGlobal(
-                    key=r.hash_key(), status=status, algorithm=r.algorithm
+        to_read: List[RateLimitReq] = []
+        for key, (r, captured) in updates.items():
+            if captured is None:
+                to_read.append(r)
+            elif not captured.error:
+                # An errored capture (validation / Gregorian) broadcasts
+                # nothing — the re-read would fail the same way and be
+                # skipped below.
+                globals_.append(
+                    UpdatePeerGlobal(
+                        key=key, status=captured, algorithm=r.algorithm
+                    )
                 )
-            )
+        if to_read:
+            # Clear GLOBAL (avoid re-queueing a broadcast,
+            # global.go:214-215) AND MULTI_REGION (a zero-hit status read
+            # must not wake the cross-region sender).
+            reads = [
+                dc_replace(
+                    r,
+                    hits=0,
+                    behavior=Behavior(
+                        int(r.behavior)
+                        & ~int(Behavior.GLOBAL)
+                        & ~int(Behavior.MULTI_REGION)
+                    ),
+                )
+                for r in to_read
+            ]
+            self.reread_batches += 1
+            self.reread_keys += len(reads)
+            try:
+                statuses = await self._read_statuses(reads)
+            except Exception as e:  # noqa: BLE001
+                # The captured entries need no read — still ship them.
+                log.error("while broadcasting update to peers: %s", e)
+                statuses = []
+            for r, status in zip(reads, statuses):
+                if status.error:
+                    continue
+                globals_.append(
+                    UpdatePeerGlobal(
+                        key=r.hash_key(), status=status,
+                        algorithm=r.algorithm,
+                    )
+                )
         if not globals_:
             return
         start = time.monotonic()

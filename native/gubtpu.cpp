@@ -270,6 +270,79 @@ int64_t gub_count_reqs(const uint8_t* buf, int64_t len) {
   return n;
 }
 
+// FNV-1 / FNV-1a (core/hashing.py fnv1_64 / fnv1a_64; the reference ring's
+// key hash, replicated_hash.go:33) of each request's hash key
+// (name + "_" + unique_key), re-walked from the spliced request frames
+// (msg_off/msg_len from gub_parse_reqs2).  variant: 0 = fnv1
+// (multiply-then-xor), 1 = fnv1a (xor-then-multiply).  out[i] = 0 when the
+// frame has no name or key (errored lanes; the router masks them anyway).
+// Keeps the columnar router serving under placement-interop rings in mixed
+// reference/tpu clusters instead of falling back to per-request routing.
+void gub_fnv_hashkey_batch(const uint8_t* buf, const int64_t* msg_off,
+                           const int64_t* msg_len, int64_t n,
+                           int32_t variant, int64_t* out) {
+  const uint64_t PRIME = 1099511628211ULL;
+  const uint64_t OFFSET = 14695981039346656037ULL;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* p = buf + msg_off[i];
+    const uint8_t* fend = p + msg_len[i];
+    out[i] = 0;
+    uint64_t tag, sz;
+    if (!get_varint(p, fend, &tag)) continue;
+    if (!get_varint(p, fend, &sz) || (uint64_t)(fend - p) < sz) continue;
+    const uint8_t* q = p;
+    const uint8_t* qend = p + sz;
+    const uint8_t* name = nullptr;
+    uint64_t name_len = 0;
+    const uint8_t* key = nullptr;
+    uint64_t key_len = 0;
+    bool ok = true;
+    while (q < qend) {
+      uint64_t t;
+      if (!get_varint(q, qend, &t)) { ok = false; break; }
+      uint32_t field = (uint32_t)(t >> 3);
+      uint32_t wire = (uint32_t)(t & 7);
+      if (wire == 2 && (field == 1 || field == 2)) {
+        uint64_t l;
+        if (!get_varint(q, qend, &l) || (uint64_t)(qend - q) < l) {
+          ok = false;
+          break;
+        }
+        if (field == 1) {
+          name = q;
+          name_len = l;
+        } else {
+          key = q;
+          key_len = l;
+        }
+        q += l;
+      } else if (!skip_field(q, qend, wire)) {
+        ok = false;
+        break;
+      }
+    }
+    if (!ok || name_len == 0 || key_len == 0) continue;
+    uint64_t h = OFFSET;
+    const uint8_t us = '_';
+    const uint8_t* parts[3] = {name, &us, key};
+    const uint64_t lens[3] = {name_len, 1, key_len};
+    if (variant == 0) {
+      for (int s = 0; s < 3; s++)
+        for (uint64_t j = 0; j < lens[s]; j++) {
+          h = h * PRIME;
+          h ^= parts[s][j];
+        }
+    } else {
+      for (int s = 0; s < 3; s++)
+        for (uint64_t j = 0; j < lens[s]; j++) {
+          h ^= parts[s][j];
+          h = h * PRIME;
+        }
+    }
+    out[i] = (int64_t)h;
+  }
+}
+
 // Parse the payload into per-request columns.  err[i]: 0 ok, 1 empty
 // unique_key, 2 empty name (matching the service's validation order and
 // messages).  hash[i] = XXH64(name + "_" + unique_key) with 0 remapped to 1;

@@ -372,11 +372,12 @@ def test_fastpath_differential_duplicate_heavy(frozen_clock):
 
 
 def test_sparse_overlap_drains():
-    """GUBER_FASTPATH_SPARSE>0 (off by default): small drains may overlap
-    the in-flight merge on the second slot.  Pin the concurrency path —
-    overlap drains actually trigger under concurrent small batches, every
-    response stays correct (each key's decrement sequence is exact), and
-    close() during traffic neither hangs nor orphans waiters."""
+    """GUBER_FASTPATH_SPARSE>0 (the shipped default is 64; 0 disables):
+    small drains may overlap the in-flight merge on an overlap slot.
+    Pin the concurrency path — overlap drains actually trigger under
+    concurrent small batches, every response stays correct (each key's
+    decrement sequence is exact), and close() during traffic neither
+    hangs nor orphans waiters."""
     conf = DaemonConfig(fastpath_sparse=64)
     c = Cluster.start(1, conf_template=conf)
     try:
@@ -1469,7 +1470,8 @@ def _free_ports(n):
             s.close()
 
 
-async def _diff_pair_start(grpc_ports, http_ports, device, disable_fp):
+async def _diff_pair_start(grpc_ports, http_ports, device, disable_fp,
+                           picker_hash="xx"):
     """Two-daemon pair on caller-pinned ports (identical vnode rings
     across sequential runs), background flush loops cancelled for
     deterministic replication, fast lane optionally detached — the
@@ -1485,6 +1487,7 @@ async def _diff_pair_start(grpc_ports, http_ports, device, disable_fp):
             http_listen_address=f"127.0.0.1:{http_ports[i]}",
             behaviors=fast_test_behaviors(),
             device=device,
+            local_picker_hash=picker_hash,
         )
         d = Daemon(conf)
         await d.start()
@@ -1533,18 +1536,25 @@ async def _diff_pair_finish(daemons, cl):
     served = sum(
         d.fastpath.served for d in daemons if d.fastpath is not None
     )
+    fallbacks = sum(
+        d.fastpath.fallbacks for d in daemons if d.fastpath is not None
+    )
     for d in daemons:
         await d.close()
-    return served
+    return served, fallbacks
 
 
-def test_multinode_routed_wire_differential(frozen_clock):
+@pytest.mark.parametrize("picker_hash", ["xx", "fnv1", "fnv1a"])
+def test_multinode_routed_wire_differential(frozen_clock, picker_hash):
     """Routed-path differential through REAL sockets: the same mixed
     stream against two sequential 2-daemon clusters on IDENTICAL fixed
     ports (=> identical vnode rings), one serving on the fast lane and
     one with it detached — responses AND every daemon's stored rows must
     match bit-for-bit, with GLOBAL hit/broadcast flushes driven at
-    identical stream points."""
+    identical stream points.  Parameterized over the ring hash: fnv1 /
+    fnv1a are the reference-placement interop rings, which the columnar
+    router must keep serving (gub_fnv_hashkey_batch) with ZERO
+    fallbacks."""
     import random
 
     from gubernator_tpu.client import AsyncV1Client
@@ -1559,7 +1569,7 @@ def test_multinode_routed_wire_differential(frozen_clock):
         daemons = await _diff_pair_start(
             ports[:2], ports[2:],
             DeviceConfig(num_slots=4096, ways=8, batch_size=64),
-            disable_fp,
+            disable_fp, picker_hash=picker_hash,
         )
         cl = AsyncV1Client(daemons[0].grpc_address)
         rng = random.Random(77)
@@ -1604,13 +1614,16 @@ def test_multinode_routed_wire_differential(frozen_clock):
                     )
             outs.append(state)
             clock_mod.advance(rng.choice([0, 100, 5_000]))
-        served = await _diff_pair_finish(daemons, cl)
-        return outs, served
+        served, fallbacks = await _diff_pair_finish(daemons, cl)
+        return outs, served, fallbacks
 
     async def scenario():
-        fast, served = await run_once(disable_fp=False)
+        fast, served, fallbacks = await run_once(disable_fp=False)
         assert served > 0  # the lane actually ran in run A
-        obj, _ = await run_once(disable_fp=True)
+        assert fallbacks == 0, (
+            f"{picker_hash} ring must be fast-lane served"
+        )
+        obj, _, _ = await run_once(disable_fp=True)
         for step, (a, b) in enumerate(zip(fast, obj)):
             assert a == b, f"divergence at record {step}"
 
@@ -1687,7 +1700,7 @@ def test_mesh_cluster_wire_differential(frozen_clock):
                 ))
             outs.append(state)
             clock_mod.advance(rng.choice([0, 100, 5_000]))
-        served = await _diff_pair_finish(daemons, cl)
+        served, _ = await _diff_pair_finish(daemons, cl)
         return outs, served
 
     async def scenario():

@@ -34,6 +34,38 @@ def test_xxh64_parity():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("variant", ["fnv1", "fnv1a"])
+def test_fnv_hashkey_batch_parity(variant):
+    """gub_fnv_hashkey_batch must equal the python fnv of each parsed
+    request's hash key (name + '_' + unique_key), with 0 on errored
+    lanes — the interop-ring route hashes (replicated_hash.go:33)."""
+    from gubernator_tpu.core.hashing import fnv1_64, fnv1a_64
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    fn = fnv1_64 if variant == "fnv1" else fnv1a_64
+    rng = random.Random(3)
+    reqs = []
+    for i in range(500):
+        name = rng.choice(["a", "rate_limit", "x" * 40, ""])
+        key = rng.choice([f"k{i}", "idé:ütf8", "", "y" * 120])
+        reqs.append(pb.RateLimitReq(
+            name=name, unique_key=key, hits=1, limit=10, duration=1000,
+        ))
+    payload = pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+    cols = native.parse_reqs(payload)
+    assert cols is not None and cols.n == len(reqs)
+    got = native.fnv_hashkey_batch(payload, cols, variant)
+    want = np.array(
+        [
+            fn((r.name + "_" + r.unique_key).encode())
+            if r.name and r.unique_key else 0
+            for r in reqs
+        ],
+        dtype=np.uint64,
+    ).view(np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
 def _random_reqs(rng, n):
     reqs = []
     for i in range(n):
