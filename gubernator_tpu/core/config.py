@@ -103,6 +103,18 @@ class SketchTierConfig:
     window_ms: int = 1000
     batch_size: int = 1024
     use_pallas: bool = False  # fused TPU kernel (ops/pallas/cms_kernel.py)
+    # Dynamic spillover (SURVEY §5 key-space scaling): when set, a name
+    # whose EXACT-tier pressure crosses a threshold is routed to this
+    # sketch tier from then on (approximate answers, metadata
+    # tier=sketch), so a cardinality bomb on one name degrades that name
+    # instead of squeezing every name's slot-table residency.  Either
+    # knob arms the mode; both are cumulative per-name counts observed
+    # on the compiled fast lane:
+    #   spill_inserts    — new-key row inserts (cardinality measure)
+    #   spill_transients — lanes denied a slot under full-bucket
+    #                      pressure (the unexpired_evictions signal)
+    spill_inserts: Optional[int] = None
+    spill_transients: Optional[int] = None
 
 
 @dataclass

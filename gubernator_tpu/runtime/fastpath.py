@@ -286,7 +286,8 @@ class FastPath:
         self.served = 0
         self.fallbacks = 0
         self._owner_frames: Dict[bytes, bytes] = {}
-        self._sk_hashes: Optional[np.ndarray] = None
+        # (membership_version, combined hash array) — see _sketch_hashes.
+        self._sk_hashes: Optional[Tuple[int, np.ndarray]] = None
 
     # -- eligibility -----------------------------------------------------
     def _eligible(self) -> bool:
@@ -298,12 +299,20 @@ class FastPath:
     def _sketch_hashes(self) -> np.ndarray:
         """XXH64 fingerprints of the sketch-tier names (route key for the
         parser's name_hash column; the same 64-bit fingerprint stance the
-        slot table takes on full keys)."""
-        if self._sk_hashes is None:
-            self._sk_hashes = native.hash_keys(
-                sorted(self.s.sketch_backend.cfg.names)
+        slot table takes on full keys).  Runtime-spilled names
+        (SketchBackend.spill_name) append to the configured set; the
+        combined array is cached per membership version — this runs in
+        the per-RPC parse path."""
+        sb = self.s.sketch_backend
+        ver = sb.membership_version
+        if self._sk_hashes is None or self._sk_hashes[0] != ver:
+            base = native.hash_keys(sorted(sb.cfg.names))
+            dyn = sb.dynamic_hashes()
+            combined = (
+                base if len(dyn) == 0 else np.concatenate([base, dyn])
             )
-        return self._sk_hashes
+            self._sk_hashes = (ver, combined)
+        return self._sk_hashes[1]
 
     def _owner_frame(self, addr: bytes) -> bytes:
         f = self._owner_frames.get(addr)
@@ -1255,6 +1264,49 @@ class FastPath:
                     cur[2] = cap
         return uniq
 
+    def _note_spill_pressure(self, entries, h_mach, foundv, persv) -> None:
+        """Feed the sketch tier's dynamic-spillover policy with this
+        drain's per-name exact-tier pressure (SketchTierConfig
+        spill_inserts/spill_transients): new-row inserts (a cardinality
+        measure) and slot-denied transients (full-bucket pressure).
+        `h_mach` is the machinery hash column (cascade-diverted lanes
+        zeroed — they had no device round).  Name strings decode lazily
+        — only the drain that crosses a threshold pays a protobuf
+        decode."""
+        if len(entries) == 1:
+            names = entries[0].cols.name_hash
+        else:
+            names = np.concatenate(
+                [e.cols.name_hash for e in entries]
+            )
+        act = h_mach != 0
+        ins = act & (foundv == 0) & (persv != 0)
+        tra = act & (persv == 0)
+        hot = ins | tra
+        if not hot.any():
+            return
+        sb = self.s.sketch_backend
+        for nh in np.unique(names[hot]):
+            idx = np.flatnonzero((names == nh) & hot)
+            i0 = int(idx[0])
+
+            def decode(i0=i0) -> str:
+                off = 0
+                for e in entries:
+                    if i0 < off + e.cols.n:
+                        return self._decode_req(
+                            e.payload, e.cols, i0 - off
+                        ).name
+                    off += e.cols.n
+                raise AssertionError("index outside drain")
+
+            if sb.note_exact_pressure(
+                int(nh), int(ins[idx].sum()), int(tra[idx].sum()), decode
+            ):
+                m = getattr(self.s.metrics, "sketch_spillover", None)
+                if m is not None:
+                    m.inc()
+
     def _repair_cold_store_keys(
         self, backend, uniq, foundv, h, cols_d, sh_all, n_shards, B,
         now_ms, out_arrays,
@@ -1495,6 +1547,7 @@ class FastPath:
         cachedv = np.zeros(n, dtype=np.int64)
         stored_st = np.zeros(n, dtype=np.int64)
         foundv = np.zeros(n, dtype=np.int64)
+        persv = np.zeros(n, dtype=np.int64)
 
         def gather(host) -> None:
             for r_idx in range(n_rounds):
@@ -1512,6 +1565,7 @@ class FastPath:
                 cachedv[sel] = hr["cached"][idx]
                 stored_st[sel] = hr["stored_status"][idx]
                 foundv[sel] = hr["found"][idx]
+                persv[sel] = hr["persisted"][idx]
 
         if plan is None and not do_store:
             # Plain merge: dispatch under the backend lock, sync outside
@@ -1653,6 +1707,14 @@ class FastPath:
             not_persisted=t.not_persisted,
             cache_hits=t.cache_hits,
         ))
+
+        sb = self.s.sketch_backend
+        if sb is not None and sb.spill_enabled:
+            # h_mach, not h: cascade-diverted duplicate occurrences never
+            # got a device lane — their persv stays 0 and raw h would
+            # count them as fake transients (a healthy hot key would
+            # self-degrade under Zipfian traffic).
+            self._note_spill_pressure(entries, h_mach, foundv, persv)
 
         # GLOBAL broadcast capture validity, judged over the WHOLE merged
         # drain (entries are concurrent RPCs; a per-entry view would miss

@@ -117,6 +117,12 @@ class Metrics:
             "Live items evicted early (victim claim over a live slot).",
             registry=r,
         )
+        self.sketch_spillover = Counter(
+            "gubernator_sketch_spillover_count",
+            "Limit names degraded from the exact tier to the count-min "
+            "sketch tier under cardinality/occupancy pressure.",
+            registry=r,
+        )
 
         # -- gRPC server (grpc_stats.go:51-63) ----------------------------
         self.grpc_request_counts = Counter(

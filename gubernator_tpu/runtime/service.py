@@ -150,8 +150,14 @@ class Service:
         )
         self._local_batcher = LocalBatcher(self)
         # Approximate tier for configured limit names (runtime/sketch_backend).
+        # A names-less config still instantiates when dynamic spillover
+        # is armed — membership then grows at runtime (spill_name).
         self.sketch_backend = None
-        if self.cfg.sketch is not None and self.cfg.sketch.names:
+        if self.cfg.sketch is not None and (
+            self.cfg.sketch.names
+            or self.cfg.sketch.spill_inserts is not None
+            or self.cfg.sketch.spill_transients is not None
+        ):
             from gubernator_tpu.runtime.sketch_backend import SketchBackend
 
             self.sketch_backend = SketchBackend(

@@ -62,6 +62,21 @@ class SketchBackend:
         self._lock = threading.Lock()
         self._compile_lock = threading.Lock()
         self.batch = cfg.batch_size
+        # Dynamic spillover state (cfg.spill_inserts/spill_transients):
+        # names the exact tier degraded here at runtime, plus the
+        # per-name-hash pressure counters feeding the policy.  Guarded by
+        # _spill_lock — the fast-lane pool reports pressure from its
+        # worker threads while the service path reads membership.
+        self._spill_lock = threading.Lock()
+        self._dyn_names: set = set()
+        self._dyn_hashes: Optional[np.ndarray] = np.empty(
+            0, dtype=np.int64
+        )
+        self._pressure: Dict[int, List[int]] = {}  # h -> [inserts, transients]
+        self.spillovers = 0  # metric mirror (sketch_spillover_total)
+        # Bumped per spill so routing caches (fastpath._sketch_hashes)
+        # rebuild their combined hash array only on membership change.
+        self.membership_version = 0
         # Host mirror of state.window_start (ms), advanced with the same
         # arithmetic as the kernel's rotation (ops/sketch.py _rotate) —
         # reset_time needs no device read-back.
@@ -71,7 +86,82 @@ class SketchBackend:
         self._multi: Dict[int, object] = {}
 
     def handles(self, req: RateLimitReq) -> bool:
-        return req.name in self.cfg.names
+        return req.name in self.cfg.names or req.name in self._dyn_names
+
+    @property
+    def spill_enabled(self) -> bool:
+        return (
+            self.cfg.spill_inserts is not None
+            or self.cfg.spill_transients is not None
+        )
+
+    def dynamic_hashes(self) -> np.ndarray:
+        """XXH64 name fingerprints of runtime-spilled names (appended to
+        the configured set by the fast lane's routing)."""
+        return self._dyn_hashes
+
+    def spill_name(self, name: str) -> bool:
+        """Route `name` to the sketch tier from now on (runtime degrade;
+        operators may call this directly).  Existing exact rows for the
+        name are orphaned and expire naturally — answers for the name
+        become approximate (metadata tier=sketch), never lost.  Returns
+        False when the name was already sketch-tier (no-op)."""
+        from gubernator_tpu import native
+
+        with self._spill_lock:
+            if name in self._dyn_names or name in self.cfg.names:
+                return False
+            self._dyn_names.add(name)
+            self._dyn_hashes = np.concatenate(
+                [self._dyn_hashes, native.hash_keys([name])]
+            )
+            self.spillovers += 1
+            self.membership_version += 1
+        import logging
+
+        logging.getLogger("gubernator_tpu.sketch").warning(
+            "exact-tier pressure: limit name %r degraded to the "
+            "count-min-sketch tier (approximate answers)", name,
+        )
+        return True
+
+    # Pressure-map size bound: one counter pair per distinct limit NAME
+    # hash.  A name sweep must not grow host memory without bound, so
+    # past the cap the smallest counters (furthest from any threshold)
+    # are dropped — they re-accumulate if their pressure was real.
+    _PRESSURE_CAP = 16_384
+
+    def note_exact_pressure(
+        self, name_hash: int, inserts: int, transients: int, decode_name
+    ) -> bool:
+        """Accumulate one drain's exact-tier pressure for a name hash;
+        spill the name when a cumulative threshold crosses.
+        `decode_name` lazily yields the name string (only called on the
+        crossing drain).  Returns True when this call actually spilled
+        the name (dedup inside spill_name — concurrent or in-flight
+        drains past the crossing report False)."""
+        ins_thr = self.cfg.spill_inserts
+        tra_thr = self.cfg.spill_transients
+        with self._spill_lock:
+            p = self._pressure.setdefault(name_hash, [0, 0])
+            p[0] += inserts
+            p[1] += transients
+            crossed = (ins_thr is not None and p[0] >= ins_thr) or (
+                tra_thr is not None and p[1] >= tra_thr
+            )
+            if crossed:
+                # The name leaves the exact tier — its counters are done.
+                self._pressure.pop(name_hash, None)
+            elif len(self._pressure) > self._PRESSURE_CAP:
+                keep = sorted(
+                    self._pressure.items(),
+                    key=lambda kv: max(kv[1][0], kv[1][1]),
+                    reverse=True,
+                )[: self._PRESSURE_CAP // 2]
+                self._pressure = dict(keep)
+        if not crossed:
+            return False
+        return self.spill_name(decode_name())
 
     def warmup(self) -> None:
         """Compile the merge step at every chunk count a coalesced drain

@@ -86,3 +86,66 @@ def test_sketch_tier_unbounded_cardinality():
         await svc.close()
 
     run(scenario())
+
+
+def test_dynamic_spillover_degrades_bombed_name():
+    """Cardinality bomb on ONE name crosses the opt-in spill threshold
+    (SketchTierConfig.spill_inserts): that name degrades to sketch
+    answers (metadata tier=sketch, spillover metric fires) while other
+    names keep exact-tier service — end to end through a daemon's
+    compiled fast lane, which is where the pressure is observed."""
+    from gubernator_tpu.client import AsyncV1Client
+    from gubernator_tpu.core.config import DaemonConfig
+    from gubernator_tpu.testing.cluster import Cluster
+
+    conf = DaemonConfig(
+        device=DEV,
+        sketch=SketchTierConfig(
+            names=[], width=4096, window_ms=60_000, batch_size=128,
+            spill_inserts=600,
+        ),
+    )
+    c = Cluster.start(1, conf_template=conf)
+    try:
+        async def scenario():
+            cl = AsyncV1Client(c.addresses()[0])
+            # Steady exact-tier name, before / during / after the bomb.
+            async def steady():
+                r = (await cl.get_rate_limits([
+                    RateLimitReq(name="steady", unique_key="acct",
+                                 hits=1, limit=1000, duration=60_000)
+                ]))[0]
+                assert r.error == ""
+                assert r.metadata.get("tier") is None
+                return r
+
+            r0 = await steady()
+            sb = c.daemons[0].service.sketch_backend
+            # Bomb: 1000 unique keys on one name crosses spill_inserts.
+            for b in range(5):
+                rs = await cl.get_rate_limits([
+                    RateLimitReq(name="bomb", unique_key=f"k{b}_{i}",
+                                 hits=1, limit=100, duration=60_000)
+                    for i in range(200)
+                ])
+                assert all(r.error == "" for r in rs)
+            assert sb.spillovers == 1
+            assert sb.handles(RateLimitReq(name="bomb", unique_key="x"))
+            # The bombed name now serves from the sketch tier...
+            r = (await cl.get_rate_limits([
+                RateLimitReq(name="bomb", unique_key="fresh", hits=1,
+                             limit=100, duration=60_000)
+            ]))[0]
+            assert r.metadata.get("tier") == "sketch"
+            # ...while the steady name stays exact, with its bucket
+            # state intact (sequential decrements continue).
+            r1 = await steady()
+            assert r1.remaining == r0.remaining - 1
+            assert not sb.handles(
+                RateLimitReq(name="steady", unique_key="acct")
+            )
+            await cl.close()
+
+        c.run(scenario())
+    finally:
+        c.stop()
