@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 from typing import List, Tuple
 
@@ -185,16 +186,23 @@ def bench(seconds: float, concurrency: int) -> None:
              time.perf_counter() - t0, {"concurrency": 4})
 
         # Latency decomposition -> the implied CO-LOCATED bound.  The rig
-        # pays a ~100-300ms dispatch->fetch turnaround per merge through
+        # pays a ~70-300ms dispatch->fetch turnaround per merge through
         # the axon tunnel; a co-located TPU host pays the device's actual
-        # step time plus a tens-of-µs interconnect sync.  Measure each
-        # component, then state the bound as:
-        #   implied = measured_p50 - merge_turnaround + device_step_exec
-        # (every term measured on this rig; the only excluded cost is the
-        # co-located PCIe/ICI sync itself, which is orders of magnitude
-        # below the stated bound).
+        # step time plus a tens-of-µs interconnect sync.  Three measured
+        # terms:
+        #   wire (client-observed) — empty request through real sockets,
+        #     python grpc.aio on BOTH ends; ~1.3ms of it is the python
+        #     CLIENT's own machinery (the reference's "<1ms" numbers are
+        #     observed by compiled Go clients);
+        #   handler — the server-side parse->serialize path alone (no
+        #     sockets): what the framework itself costs per request;
+        #   exec — true per-step device execution, measured in a FRESH
+        #     subprocess that never fetches: after a process's first d2h
+        #     fetch this rig's tunnel degrades every later dispatch to
+        #     ~one RTT (the sticky per-command sync mode), so in-process
+        #     pipelined timing would report tunnel dispatch, not device
+        #     execution.  Co-located hosts have no such mode.
         be = c.daemons[0].service.backend
-        import jax as _jax
 
         def merge_cycle_ms(reps: int = 5) -> float:
             """One small-batch merge's dispatch->fetch cycle on this rig."""
@@ -207,57 +215,110 @@ def bench(seconds: float, concurrency: int) -> None:
                     np.asarray(resp)
                 return (time.perf_counter() - t0) / reps * 1e3
 
-        def step_exec_ms(k: int = 50) -> float:
-            """Amortized per-step device execution under pipelined
-            dispatch (the co-located cost of one merge's compute)."""
-            q = np.zeros((12, 128), dtype=np.int64)
-            now = np.int64(be.clock.millisecond_now())
-            with be._lock:
-                # One throwaway cycle to settle the pipe.
-                be.table, r0 = be._step_packed_q(be.table, q, now)
-                np.asarray(r0)
+        def clean_exec_ms():
+            """Per-step device execution from a fetch-free subprocess
+            (block_until_ready only — readiness waits don't trigger the
+            tunnel's sticky post-fetch dispatch mode).  Returns
+            (ms, source): source says whether the subprocess measurement
+            succeeded or the in-process rig turnaround was substituted —
+            the emitted artifact must never pass tunnel latency off as
+            device execution."""
+            import subprocess
+            import sys as _sys
+
+            code = (
+                "import sys, time\n"
+                "sys.path.insert(0, %r)\n"
+                "import numpy as np, jax\n"
+                "from gubernator_tpu.ops.state import init_table\n"
+                "from gubernator_tpu.ops.step import apply_batch_packed_q\n"
+                "table = init_table(%d)\n"
+                "q = jax.device_put(np.zeros((12, 128), dtype=np.int64))\n"
+                "now = np.int64(1_700_000_000_000)\n"
+                "table, r = apply_batch_packed_q(table, q, now, ways=8)\n"
+                "jax.block_until_ready(r)\n"
+                "t0 = time.perf_counter()\n"
+                "for _ in range(60):\n"
+                "    table, r = apply_batch_packed_q(table, q, now, ways=8)\n"
+                "jax.block_until_ready(r)\n"
+                "print((time.perf_counter() - t0) / 60 * 1e3)\n"
+            ) % (os.path.dirname(os.path.abspath(__file__)),
+                 dev_cfg.num_slots)
+            try:
+                out = subprocess.run(
+                    [_sys.executable, "-c", code], capture_output=True,
+                    text=True, timeout=300,
+                )
+                return (
+                    float(out.stdout.strip().splitlines()[-1]),
+                    "fetch-free-subprocess",
+                )
+            except Exception:  # noqa: BLE001 — fall back, LABELED
+                return merge_cycle_ms(), "rig-turnaround-fallback"
+
+        async def handler_only(k: int = 3000):
+            fp = c.daemons[0].fastpath
+            empty_p = build_payload([])
+            for _ in range(50):
+                await fp.check_raw(empty_p, peer_rpc=False)
+            lats = []
+            for _ in range(k):
                 t0 = time.perf_counter()
-                resps = []
-                for _ in range(k):
-                    be.table, r = be._step_packed_q(be.table, q, now)
-                    resps.append(r)
-                _jax.block_until_ready(resps)
-                wall = (time.perf_counter() - t0) * 1e3
-            return max(wall - turnaround_ms, 0.0) / k
+                await fp.check_raw(empty_p, peer_rpc=False)
+                lats.append(time.perf_counter() - t0)
+            return lats
 
         turnaround_ms = merge_cycle_ms()
-        exec_ms = step_exec_ms()
+        exec_ms, exec_src = clean_exec_ms()
         # Wire loopback WITHOUT the device: an empty GetRateLimitsReq
         # rides the full gRPC + fast-lane parse/serialize path and
-        # returns before any device work — the co-located non-device
-        # latency floor, measured through real sockets at the same
-        # concurrency as the latency config.
+        # returns before any device work — measured through real sockets
+        # at the same concurrency as the latency config.
         empty = build_payload([])
         _, lb_lat = c.run(drive(addr, [empty], 2.0, 4), timeout=120)
         lb50, lb99 = _percentiles(lb_lat)
+        h50, h99 = _percentiles(c.run(handler_only(), timeout=120))
         lat_line = next(
             r for r in results if r["config"] == "latency_small_batch"
         )
         bound = {
             "config": "colocated_latency_bound",
             "note": (
-                "wire loopback (gRPC + parse/serialize through real "
-                "sockets, no device) plus TWO pipelined merge executions "
-                "(a small-batch request spans at most the in-flight "
-                "merge plus its own under the depth-1 drain discipline); "
-                "every term measured on this rig — the co-located "
-                "interconnect sync (tens of µs) is the only excluded "
-                "cost.  The rig's measured merge turnaround is what "
+                "a small-batch request spans at most the in-flight merge "
+                "plus its own under the depth-1 drain discipline, so the "
+                "bound is wire + 2 merge executions.  Stated twice: "
+                "python_client uses the client-observed loopback (python "
+                "grpc.aio machinery on both ends, ~1.3ms of it client-"
+                "side); compiled_client uses the server-side handler "
+                "path alone + a 0.1ms transport allowance — the "
+                "reference's own '<1ms for most batched responses' is "
+                "observed by compiled Go clients (README.md:98-104).  "
+                "exec is true device execution from a fetch-free "
+                "subprocess; the rig's sticky post-fetch dispatch mode "
+                "(and its ~70-300ms fetch turnaround) is what "
                 "co-location removes."
             ),
             "wire_loopback_p50_ms": round(lb50, 3),
             "wire_loopback_p99_ms": round(lb99, 3),
+            "handler_p50_ms": round(h50, 3),
+            "handler_p99_ms": round(h99, 3),
             "device_step_exec_ms": round(exec_ms, 3),
+            "device_step_exec_src": exec_src,
             "rig_merge_turnaround_ms": round(turnaround_ms, 2),
             "measured_rig_p50_ms": lat_line["p50_ms"],
             "measured_rig_p99_ms": lat_line["p99_ms"],
-            "implied_colocated_p50_ms": round(lb50 + 2 * exec_ms, 3),
-            "implied_colocated_p99_ms": round(lb99 + 2 * exec_ms, 3),
+            "implied_colocated_python_client_p50_ms": round(
+                lb50 + 2 * exec_ms, 3
+            ),
+            "implied_colocated_python_client_p99_ms": round(
+                lb99 + 2 * exec_ms, 3
+            ),
+            "implied_colocated_compiled_client_p50_ms": round(
+                h50 + 0.1 + 2 * exec_ms, 3
+            ),
+            "implied_colocated_compiled_client_p99_ms": round(
+                h99 + 0.1 + 2 * exec_ms, 3
+            ),
         }
         results.append(bound)
         print(json.dumps(bound), flush=True)
@@ -375,15 +436,16 @@ def bench(seconds: float, concurrency: int) -> None:
                 "note": (
                     "per-daemon device dispatch->fetch cycles during the "
                     "global_4peer window.  fastlane_drains serve client "
-                    "AND forwarded peer batches (one cycle each); "
-                    "batcher_steps are object-path steps — on this "
-                    "cluster exclusively the broadcast zero-hit re-reads "
-                    "(reread_batches), whose re-read semantics the "
-                    "reference shares (global.go:205-250) and which stay "
-                    "OFF the compiled lane on purpose: merged re-reads "
-                    "break same-key cascade eligibility (A/B'd 20k -> 5k "
-                    "checks/s).  Broadcast RECEIVES (apply_cached_rows) "
-                    "dispatch without a fetch and cost no cycle."
+                    "AND forwarded peer batches (one cycle each).  "
+                    "Broadcast rows are CAPTURED from each drain's own "
+                    "post-step stored columns (r5), so the zero-hit "
+                    "re-read steps of global.go:205-250 run only as a "
+                    "fallback (reread_batches — 0 in steady state; a "
+                    "capture degrades to the re-read when a later "
+                    "occurrence moved the row, on RESET_REMAINING, or "
+                    "on a leaky overfill clamp).  Broadcast RECEIVES "
+                    "(apply_cached_rows) dispatch without a fetch and "
+                    "cost no cycle."
                 ),
                 "checks": rpcs * 1000,
                 "cluster_cycles": total_cycles,

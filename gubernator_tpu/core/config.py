@@ -108,11 +108,16 @@ class SketchTierConfig:
     # sketch tier from then on (approximate answers, metadata
     # tier=sketch), so a cardinality bomb on one name degrades that name
     # instead of squeezing every name's slot-table residency.  Either
-    # knob arms the mode; both are cumulative per-name counts observed
-    # on the compiled fast lane:
-    #   spill_inserts    — new-key row inserts (cardinality measure)
-    #   spill_transients — lanes denied a slot under full-bucket
-    #                      pressure (the unexpired_evictions signal)
+    # knob arms the mode; pressure is observed on the compiled fast
+    # lane:
+    #   spill_inserts    — estimated DISTINCT keys for the name (a
+    #                      per-name HyperLogLog over insert-lane key
+    #                      fingerprints, ~±13%; expiry/re-insert churn
+    #                      of a small healthy key set does NOT
+    #                      accumulate)
+    #   spill_transients — cumulative lanes denied a slot under
+    #                      full-bucket pressure (zero for a healthy
+    #                      table; the unexpired_evictions signal)
     spill_inserts: Optional[int] = None
     spill_transients: Optional[int] = None
 

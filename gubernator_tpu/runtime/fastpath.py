@@ -1267,12 +1267,14 @@ class FastPath:
     def _note_spill_pressure(self, entries, h_mach, foundv, persv) -> None:
         """Feed the sketch tier's dynamic-spillover policy with this
         drain's per-name exact-tier pressure (SketchTierConfig
-        spill_inserts/spill_transients): new-row inserts (a cardinality
-        measure) and slot-denied transients (full-bucket pressure).
-        `h_mach` is the machinery hash column (cascade-diverted lanes
-        zeroed — they had no device round).  Name strings decode lazily
-        — only the drain that crosses a threshold pays a protobuf
-        decode."""
+        spill_inserts/spill_transients): insert lanes' key fingerprints
+        (the backend's per-name HyperLogLog turns them into a DISTINCT-
+        key estimate, immune to expiry/re-insert churn) and slot-denied
+        transients (full-bucket pressure).  `h_mach` is the machinery
+        hash column (cascade-diverted lanes zeroed — they had no device
+        round).  One sort groups hot lanes by name — no per-name array
+        scans (a name-sweep attack makes U ≈ n) — and name strings
+        decode lazily, only for threshold-crossing names."""
         if len(entries) == 1:
             names = entries[0].cols.name_hash
         else:
@@ -1282,30 +1284,41 @@ class FastPath:
         act = h_mach != 0
         ins = act & (foundv == 0) & (persv != 0)
         tra = act & (persv == 0)
-        hot = ins | tra
-        if not hot.any():
+        hot = np.flatnonzero(ins | tra)
+        if not len(hot):
             return
-        sb = self.s.sketch_backend
-        for nh in np.unique(names[hot]):
-            idx = np.flatnonzero((names == nh) & hot)
-            i0 = int(idx[0])
+        order = hot[np.argsort(names[hot], kind="stable")]
+        ns = names[order]
+        bounds = np.flatnonzero(
+            np.concatenate([[True], ns[1:] != ns[:-1]])
+        )
+        items = []
+        first_idx: Dict[int, int] = {}
+        for b_i, lo in enumerate(bounds):
+            hi = bounds[b_i + 1] if b_i + 1 < len(bounds) else len(order)
+            grp = order[lo:hi]
+            nh = int(ns[lo])
+            first_idx[nh] = int(grp[0])
+            items.append((
+                nh,
+                h_mach[grp[ins[grp]]],
+                int(tra[grp].sum()),
+            ))
 
-            def decode(i0=i0) -> str:
-                off = 0
-                for e in entries:
-                    if i0 < off + e.cols.n:
-                        return self._decode_req(
-                            e.payload, e.cols, i0 - off
-                        ).name
-                    off += e.cols.n
-                raise AssertionError("index outside drain")
+        def decode_names(nh: int) -> str:
+            i0 = first_idx[nh]
+            off = 0
+            for e in entries:
+                if i0 < off + e.cols.n:
+                    return self._decode_req(
+                        e.payload, e.cols, i0 - off
+                    ).name
+                off += e.cols.n
+            raise AssertionError("index outside drain")
 
-            if sb.note_exact_pressure(
-                int(nh), int(ins[idx].sum()), int(tra[idx].sum()), decode
-            ):
-                m = getattr(self.s.metrics, "sketch_spillover", None)
-                if m is not None:
-                    m.inc()
+        self.s.sketch_backend.note_exact_pressure_batch(
+            items, decode_names
+        )
 
     def _repair_cold_store_keys(
         self, backend, uniq, foundv, h, cols_d, sh_all, n_shards, B,

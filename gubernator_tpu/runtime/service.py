@@ -163,6 +163,9 @@ class Service:
             self.sketch_backend = SketchBackend(
                 self.cfg.sketch, clock=self.clock
             )
+            # Every actual spill — policy-driven or operator-called —
+            # hits the Prometheus counter.
+            self.sketch_backend.on_spill = self.metrics.sketch_spillover.inc
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         # On a mesh backend, GLOBAL keys owned by THIS node serve from the

@@ -64,16 +64,27 @@ class SketchBackend:
         self.batch = cfg.batch_size
         # Dynamic spillover state (cfg.spill_inserts/spill_transients):
         # names the exact tier degraded here at runtime, plus the
-        # per-name-hash pressure counters feeding the policy.  Guarded by
+        # per-name-hash pressure state feeding the policy.  Guarded by
         # _spill_lock — the fast-lane pool reports pressure from its
         # worker threads while the service path reads membership.
+        # Pressure per name is (hll_registers uint8[64], transients):
+        # cardinality comes from a HyperLogLog over the insert lanes'
+        # 64-bit key fingerprints, NOT a raw insert count — a long-lived
+        # healthy name whose keys expire and re-insert must never look
+        # like a cardinality bomb (the estimate converges on DISTINCT
+        # keys; ~±13% at 64 registers, plenty for an order-of-magnitude
+        # threshold).
         self._spill_lock = threading.Lock()
         self._dyn_names: set = set()
         self._dyn_hashes: Optional[np.ndarray] = np.empty(
             0, dtype=np.int64
         )
-        self._pressure: Dict[int, List[int]] = {}  # h -> [inserts, transients]
+        self._pressure: Dict[int, list] = {}  # h -> [hll_regs, transients]
         self.spillovers = 0  # metric mirror (sketch_spillover_total)
+        # Optional hook fired once per actual spill (the Service wires
+        # the Prometheus counter here so operator-initiated spill_name
+        # calls count too).
+        self.on_spill = None
         # Bumped per spill so routing caches (fastpath._sketch_hashes)
         # rebuild their combined hash array only on membership change.
         self.membership_version = 0
@@ -117,51 +128,94 @@ class SketchBackend:
             )
             self.spillovers += 1
             self.membership_version += 1
+            hook = self.on_spill
         import logging
 
         logging.getLogger("gubernator_tpu.sketch").warning(
             "exact-tier pressure: limit name %r degraded to the "
             "count-min-sketch tier (approximate answers)", name,
         )
+        if hook is not None:
+            hook()
         return True
 
-    # Pressure-map size bound: one counter pair per distinct limit NAME
-    # hash.  A name sweep must not grow host memory without bound, so
-    # past the cap the smallest counters (furthest from any threshold)
-    # are dropped — they re-accumulate if their pressure was real.
+    # Pressure-map size bound: one entry (64-byte HLL + a counter) per
+    # distinct limit NAME hash.  A name sweep must not grow host memory
+    # without bound, so past the cap the entries furthest from any
+    # threshold are dropped — they re-accumulate if the pressure was
+    # real.
     _PRESSURE_CAP = 16_384
+    _HLL_M = 64  # registers; standard error ~1.04/sqrt(m) ≈ 13%
 
-    def note_exact_pressure(
-        self, name_hash: int, inserts: int, transients: int, decode_name
-    ) -> bool:
-        """Accumulate one drain's exact-tier pressure for a name hash;
-        spill the name when a cumulative threshold crosses.
-        `decode_name` lazily yields the name string (only called on the
-        crossing drain).  Returns True when this call actually spilled
-        the name (dedup inside spill_name — concurrent or in-flight
-        drains past the crossing report False)."""
+    @staticmethod
+    def _hll_estimate(regs: np.ndarray) -> float:
+        m = len(regs)
+        est = (0.709 * m * m) / float(
+            np.sum(np.exp2(-regs.astype(np.float64)))
+        )
+        if est <= 2.5 * m:
+            zeros = int((regs == 0).sum())
+            if zeros:
+                est = m * np.log(m / zeros)  # small-range correction
+        return est
+
+    def note_exact_pressure_batch(self, items, decode_names) -> int:
+        """Accumulate one drain's exact-tier pressure and spill names
+        whose thresholds cross.  `items` is a list of
+        (name_hash, insert_key_hashes int64[], transients_count);
+        `decode_names(name_hash)` lazily yields the name string (only
+        called for crossing names).  One lock hold covers the whole
+        drain.  Returns the number of names actually spilled (dedup
+        inside spill_name)."""
         ins_thr = self.cfg.spill_inserts
         tra_thr = self.cfg.spill_transients
+        m = self._HLL_M
+        crossed: List[int] = []
         with self._spill_lock:
-            p = self._pressure.setdefault(name_hash, [0, 0])
-            p[0] += inserts
-            p[1] += transients
-            crossed = (ins_thr is not None and p[0] >= ins_thr) or (
-                tra_thr is not None and p[1] >= tra_thr
-            )
-            if crossed:
-                # The name leaves the exact tier — its counters are done.
-                self._pressure.pop(name_hash, None)
-            elif len(self._pressure) > self._PRESSURE_CAP:
+            for name_hash, ins_keys, transients in items:
+                p = self._pressure.get(name_hash)
+                if p is None:
+                    p = [np.zeros(m, dtype=np.uint8), 0]
+                    self._pressure[name_hash] = p
+                if len(ins_keys):
+                    # HLL update: register = LOW 6 bits of the key
+                    # fingerprint (robust to any bias in the high bits),
+                    # rank = leading-zeros+1 of the remaining 58 bits.
+                    u = ins_keys.view(np.uint64)
+                    reg = (u & np.uint64(m - 1)).astype(np.int64)
+                    bits = (u >> np.uint64(6)) << np.uint64(6)
+                    rank = np.ones(len(u), dtype=np.uint8)
+                    for shift in (32, 16, 8, 4, 2, 1):
+                        hi = bits >> np.uint64(64 - shift)
+                        z = hi == 0
+                        rank = np.where(
+                            z, rank + np.uint8(shift), rank
+                        ).astype(np.uint8)
+                        bits = np.where(z, bits << np.uint64(shift), bits)
+                    np.maximum.at(p[0], reg, rank)
+                p[1] += int(transients)
+                over = (
+                    ins_thr is not None
+                    and self._hll_estimate(p[0]) >= ins_thr
+                ) or (tra_thr is not None and p[1] >= tra_thr)
+                if over:
+                    # The name leaves the exact tier — state done.
+                    self._pressure.pop(name_hash, None)
+                    crossed.append(name_hash)
+            if len(self._pressure) > self._PRESSURE_CAP:
                 keep = sorted(
                     self._pressure.items(),
-                    key=lambda kv: max(kv[1][0], kv[1][1]),
+                    key=lambda kv: max(
+                        int(kv[1][0].max()), kv[1][1]
+                    ),
                     reverse=True,
                 )[: self._PRESSURE_CAP // 2]
                 self._pressure = dict(keep)
-        if not crossed:
-            return False
-        return self.spill_name(decode_name())
+        spilled = 0
+        for nh in crossed:
+            if self.spill_name(decode_names(nh)):
+                spilled += 1
+        return spilled
 
     def warmup(self) -> None:
         """Compile the merge step at every chunk count a coalesced drain
