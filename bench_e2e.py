@@ -307,19 +307,28 @@ def bench(seconds: float, concurrency: int) -> None:
             "rig_merge_turnaround_ms": round(turnaround_ms, 2),
             "measured_rig_p50_ms": lat_line["p50_ms"],
             "measured_rig_p99_ms": lat_line["p99_ms"],
-            "implied_colocated_python_client_p50_ms": round(
-                lb50 + 2 * exec_ms, 3
-            ),
-            "implied_colocated_python_client_p99_ms": round(
-                lb99 + 2 * exec_ms, 3
-            ),
-            "implied_colocated_compiled_client_p50_ms": round(
-                h50 + 0.1 + 2 * exec_ms, 3
-            ),
-            "implied_colocated_compiled_client_p99_ms": round(
-                h99 + 0.1 + 2 * exec_ms, 3
-            ),
         }
+        if exec_src == "fetch-free-subprocess":
+            bound.update({
+                "implied_colocated_python_client_p50_ms": round(
+                    lb50 + 2 * exec_ms, 3
+                ),
+                "implied_colocated_python_client_p99_ms": round(
+                    lb99 + 2 * exec_ms, 3
+                ),
+                "implied_colocated_compiled_client_p50_ms": round(
+                    h50 + 0.1 + 2 * exec_ms, 3
+                ),
+                "implied_colocated_compiled_client_p99_ms": round(
+                    h99 + 0.1 + 2 * exec_ms, 3
+                ),
+            })
+        else:
+            # The exec term is rig turnaround, not device execution — an
+            # implied co-located number from it would be fiction.
+            bound["implied_colocated_bounds"] = (
+                "omitted: exec measurement fell back to rig turnaround"
+            )
         results.append(bound)
         print(json.dumps(bound), flush=True)
 

@@ -203,11 +203,21 @@ class SketchBackend:
                     self._pressure.pop(name_hash, None)
                     crossed.append(name_hash)
             if len(self._pressure) > self._PRESSURE_CAP:
+                # Rank by normalized distance to the NEAREST threshold
+                # (a raw register-vs-count comparison would let junk
+                # transients evict a near-threshold cardinality bomb's
+                # HLL state under a concurrent name sweep).
+                def closeness(p) -> float:
+                    c = 0.0
+                    if ins_thr is not None:
+                        c = max(c, self._hll_estimate(p[0]) / ins_thr)
+                    if tra_thr is not None:
+                        c = max(c, p[1] / tra_thr)
+                    return c
+
                 keep = sorted(
                     self._pressure.items(),
-                    key=lambda kv: max(
-                        int(kv[1][0].max()), kv[1][1]
-                    ),
+                    key=lambda kv: closeness(kv[1]),
                     reverse=True,
                 )[: self._PRESSURE_CAP // 2]
                 self._pressure = dict(keep)

@@ -517,6 +517,15 @@ def test_fastpath_store_differential(frozen_clock):
                 assert g.status == int(w.status), (step, j)
                 assert g.remaining == w.remaining, (step, j)
                 assert g.reset_time == w.reset_time, (step, j)
+            # Drive the GLOBAL broadcast at the same stream point on both
+            # services: the fast side ships drain-captured rows while the
+            # ref side runs the zero-hit re-read (which, store-attached,
+            # rides the full seeding/write-through path) — rows and store
+            # contents must still match bit-for-bit.
+            for svc in (s_fast, s_ref):
+                upd = svc.global_mgr._take_updates()
+                if upd:
+                    await svc.global_mgr._broadcast_peers(upd)
             # Device rows AND store contents must match bit-for-bit.
             for k in [f"diff_d{i}" for i in range(8)]:
                 a = s_fast.backend.get_cache_item(k)

@@ -171,3 +171,52 @@ def test_global_engine_store_persistence(frozen_clock):
     eng2 = GlobalEngine(b2)
     r = eng2.check([greq("gs0", hits=2)])
     assert r[0].remaining == 2
+
+
+def test_mesh_fastpath_cold_key_repair(frozen_clock):
+    """The compiled lane's cold-key store repair on a SHARDED backend:
+    a drain whose key misses the table consults the Store post-step (the
+    step's own `found` column — no residency probe) and repairs the
+    fresh row in place; responses and the final row continue from the
+    store state, identically to the object path's seed-then-step."""
+    import asyncio
+
+    from gubernator_tpu.core.config import Config
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    async def scenario():
+        now = frozen_clock.millisecond_now()
+        store = MockStore()
+        # Half-drained state for two cold keys on different shards.
+        for k in ("cold_a", "cold_b"):
+            store.data[f"p_{k}"] = CacheItem(
+                key=f"p_{k}", algorithm=Algorithm.TOKEN_BUCKET,
+                expire_at=now + 60_000, limit=20, duration=60_000,
+                remaining=7, created_at=now,
+            )
+        svc = Service(
+            Config(device=MESH_DEV, store=store), clock=frozen_clock
+        )
+        await svc.start()
+        fp = FastPath(svc)
+        reqs = [
+            pb.RateLimitReq(name="p", unique_key=k, hits=1, limit=20,
+                            duration=60_000)
+            for k in ("cold_a", "cold_b", "warmless")
+        ] * 2  # duplicates: the repair re-runs every occurrence in order
+        payload = pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+        out = await fp.check_raw(payload, peer_rpc=False)
+        assert out is not None
+        got = pb.GetRateLimitsResp.FromString(out).responses
+        # cold keys continue 7 -> 6 -> 5; the storeless key starts fresh.
+        assert [g.remaining for g in got] == [6, 6, 19, 5, 5, 18]
+        assert store.called["get"] == 3  # one consult per unique key
+        for k, want in (("cold_a", 5), ("cold_b", 5), ("warmless", 18)):
+            it = svc.backend.get_cache_item(f"p_{k}")
+            assert it is not None and it.remaining == want, k
+        await fp.close()
+        await svc.close()
+
+    asyncio.run(scenario())
