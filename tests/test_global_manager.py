@@ -11,7 +11,13 @@ import asyncio
 from types import SimpleNamespace
 
 from gubernator_tpu.core.config import BehaviorConfig, Config
-from gubernator_tpu.core.types import Behavior, PeerInfo, RateLimitReq
+from gubernator_tpu.core.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
 from gubernator_tpu.net.peer_client import PeerNotReadyError
 from gubernator_tpu.runtime.metrics import Metrics
 from gubernator_tpu.runtime.service import GlobalManager
@@ -173,5 +179,140 @@ def test_successful_send_counts_once():
         assert peer.applied == [("g_c", 5)]
         assert mgr._hits == {}
         assert mgr.async_sends == 1
+
+    run(scenario())
+
+
+class FakeBroadcastPeer:
+    """Non-owner stand-in recording UpdatePeerGlobals pushes."""
+
+    def __init__(self):
+        self.received = []  # UpdatePeerGlobal rows
+
+    def info(self) -> PeerInfo:
+        return PeerInfo(grpc_address="fake:5678", is_owner=False)
+
+    async def update_peer_globals(self, globals_):
+        self.received.extend(globals_)
+
+
+def _bcast_manager(peer, read_statuses=None):
+    """Manager whose service exposes just what _broadcast_peers needs.
+    Re-read calls are recorded in the returned manager's
+    `reread_calls` list — production swallows exceptions on that path,
+    so detection must be by inspection, not by raising."""
+    behaviors = BehaviorConfig(
+        global_sync_wait_s=0.001, global_timeout_s=1.0
+    )
+    calls: list = []
+
+    async def _check_local(reqs, use_cached=None):
+        calls.append(list(reqs))
+        assert read_statuses is not None, "unexpected re-read"
+        return [read_statuses(r) for r in reqs]
+
+    svc = SimpleNamespace(
+        cfg=Config(behaviors=behaviors),
+        metrics=Metrics(),
+        peer_list=lambda: [peer],
+        _check_local=_check_local,
+    )
+    mgr = GlobalManager(svc)  # type: ignore[arg-type]
+    mgr.reread_calls = calls
+    return mgr
+
+
+def test_captured_update_broadcasts_without_reread():
+    """A drain-captured status ships directly: no zero-hit re-read runs
+    (the r5 capture path; global.go:205-250's read is skipped)."""
+    async def scenario():
+        peer = FakeBroadcastPeer()
+        mgr = _bcast_manager(peer)  # re-read would raise
+        cap = RateLimitResp(
+            status=Status.UNDER_LIMIT, limit=100, remaining=42,
+            reset_time=123_456,
+        )
+        mgr.queue_update(_req("k1"), cap)
+        await mgr._broadcast_peers(mgr._take_updates())
+        assert [(g.key, g.status.remaining) for g in peer.received] == [
+            ("g_k1", 42)
+        ]
+        assert mgr.reread_batches == 0
+        assert mgr.reread_calls == []  # the re-read path never ran
+        assert mgr.broadcasts == 1
+
+    run(scenario())
+
+
+def test_degraded_and_errored_entries():
+    """None-capture entries re-read; sentinel-errored captures are
+    skipped entirely (the re-read would fail the same way)."""
+    async def scenario():
+        peer = FakeBroadcastPeer()
+        mgr = _bcast_manager(
+            peer,
+            read_statuses=lambda r: RateLimitResp(remaining=7, limit=100),
+        )
+        mgr.queue_update(_req("plain"))          # None -> re-read
+        mgr.queue_update(
+            _req("bad"), RateLimitResp(error="capture: errored lane")
+        )                                        # sentinel -> skipped
+        await mgr._broadcast_peers(mgr._take_updates())
+        assert [(g.key, g.status.remaining) for g in peer.received] == [
+            ("g_plain", 7)
+        ]
+        assert mgr.reread_batches == 1
+        assert mgr.reread_keys == 1
+
+    run(scenario())
+
+
+def test_touch_degrades_pending_capture():
+    """touch_hashes on a captured key's fingerprint degrades the entry
+    to the re-read path; unrelated fingerprints leave it captured."""
+    import numpy as np
+
+    from gubernator_tpu.core.hashing import key_hash64
+    async def scenario():
+        peer = FakeBroadcastPeer()
+        mgr = _bcast_manager(
+            peer,
+            read_statuses=lambda r: RateLimitResp(remaining=1, limit=100),
+        )
+        cap = RateLimitResp(remaining=42, limit=100)
+        mgr.queue_update(_req("t1"), cap)
+        other = np.array(
+            [np.uint64(key_hash64("g_somethingelse")).view(np.int64)]
+        )
+        mgr.touch_hashes(other)
+        assert mgr._updates["g_t1"][1] is cap  # untouched
+        mine = np.array(
+            [np.uint64(key_hash64("g_t1")).view(np.int64)]
+        )
+        mgr.touch_hashes(mine)
+        assert mgr._updates["g_t1"][1] is None  # degraded
+        await mgr._broadcast_peers(mgr._take_updates())
+        assert [g.status.remaining for g in peer.received] == [1]
+        assert mgr.reread_batches == 1
+
+    run(scenario())
+
+
+def test_reread_failure_still_ships_captured():
+    """A failing re-read batch must not discard independent captured
+    rows collected in the same flush window."""
+    async def scenario():
+        peer = FakeBroadcastPeer()
+
+        def boom(r):
+            raise RuntimeError("device exploded")
+
+        mgr = _bcast_manager(peer, read_statuses=boom)
+        mgr.queue_update(_req("cap"), RateLimitResp(remaining=9, limit=100))
+        mgr.queue_update(_req("readme"))  # re-read will fail
+        await mgr._broadcast_peers(mgr._take_updates())
+        assert [(g.key, g.status.remaining) for g in peer.received] == [
+            ("g_cap", 9)
+        ]
 
     run(scenario())
