@@ -1,0 +1,97 @@
+"""Offline multi-seed differential sweeps — deeper than the CI seeds.
+
+Usage:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/sweep_differentials.py mixed 0 20
+    python scripts/sweep_differentials.py store 0 15
+    python scripts/sweep_differentials.py routed        # all hashes x seeds
+    python scripts/sweep_differentials.py mesh          # extra seeds
+
+`mixed` and `store` replay the in-repo fuzz differentials with arbitrary
+seed ranges; `routed`/`mesh` re-run the wire differentials with a
+seed-overriding random.Random so the fixed in-test streams vary.  Run
+before shipping any change to runtime/fastpath.py, ops/step.py response
+semantics, or the GLOBAL managers (see tests/test_fastpath.py for the
+tiers these deepen).
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import conftest  # noqa: E402,F401 — pins the CPU platform pre-jax
+
+from gubernator_tpu.core import clock as clock_mod  # noqa: E402
+
+_orig_random = _random.Random
+
+
+class _SeededRandom(_orig_random):
+    seed_override = None
+
+    def __init__(self, seed=None):
+        super().__init__(
+            self.seed_override if self.seed_override is not None else seed
+        )
+
+
+def _with_seed(seed, fn, *args):
+    _SeededRandom.seed_override = seed
+    _random.Random = _SeededRandom
+    clock_mod.freeze()
+    try:
+        fn(clock_mod.default_clock(), *args)
+    finally:
+        clock_mod.unfreeze()
+        _random.Random = _orig_random
+
+
+def main() -> None:
+    import test_fastpath as tf
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "mixed"
+    lo = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    hi = int(sys.argv[3]) if len(sys.argv) > 3 else lo + 10
+    if which == "mixed":
+        for s in range(lo, hi):
+            clock_mod.freeze()
+            try:
+                tf.test_fastpath_differential_mixed_behaviors(
+                    clock_mod.default_clock(), s
+                )
+            finally:
+                clock_mod.unfreeze()
+            print(f"mixed seed {s} ok", flush=True)
+    elif which == "store":
+        for s in range(lo, hi):
+            _with_seed(s, tf.test_fastpath_store_differential)
+            print(f"store seed {s} ok", flush=True)
+    elif which == "routed":
+        for ph in ("xx", "fnv1", "fnv1a"):
+            for s in range(lo, max(hi, lo + 2)):
+                _with_seed(
+                    s, tf.test_multinode_routed_wire_differential, ph
+                )
+                print(f"routed {ph} seed {s} ok", flush=True)
+    elif which == "mesh":
+        for s in range(lo, hi):
+            _with_seed(s, tf.test_mesh_cluster_wire_differential)
+            print(f"mesh seed {s} ok", flush=True)
+    else:
+        raise SystemExit(f"unknown sweep {which!r}")
+
+
+if __name__ == "__main__":
+    main()
