@@ -40,9 +40,18 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+_T0 = time.perf_counter()
+
+
+def _phase(msg: str) -> None:
+    """Progress to stderr (stdout carries only the single JSON line)."""
+    sys.stderr.write("[bench %7.1fs] %s\n" % (time.perf_counter() - _T0, msg))
+    sys.stderr.flush()
 
 
 def main() -> None:
@@ -54,66 +63,105 @@ def main() -> None:
     num_slots = 1 << 24
     ways = 8
     batch = int(os.environ.get("BENCH_BATCH", 262_144))
-    n_keys = 10_000_000
+    n_keys = int(os.environ.get("BENCH_KEYS", 10_000_000))
     n_staged = 8
     now0 = 1_700_000_000_000
 
     rng = np.random.default_rng(0)
     key_pool = rng.integers(1, 1 << 63, size=n_keys, dtype=np.int64)
+    _phase("key pool generated")
 
-    def make_batch(ks: np.ndarray) -> DeviceBatchJ:
-        pad = batch - len(ks)
-        if pad:
-            ks = np.concatenate([ks, np.zeros(pad, dtype=np.int64)])
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gubernator_tpu.ops.step import apply_batch_impl
+
+    def batch_from_keys(ks) -> DeviceBatchJ:
+        """Expand a [batch] key vector into a full DeviceBatchJ on device —
+        only the 8-byte/key key column ever crosses the host link."""
         active = ks != 0
-        algo = ((ks.astype(np.uint64) >> np.uint64(7)) & np.uint64(1)).astype(
-            np.int32
-        )
-        limit = np.full(batch, 1000, dtype=np.int64)
+        algo = (
+            (ks.astype(jnp.uint64) >> jnp.uint64(7)) & jnp.uint64(1)
+        ).astype(jnp.int32)
+        limit = jnp.full((batch,), 1000, jnp.int64)
+        zi = jnp.zeros((batch,), jnp.int64)
+        zb = jnp.zeros((batch,), jnp.bool_)
         return DeviceBatchJ(
             key_hash=ks,
-            hits=active.astype(np.int64),
+            hits=active.astype(jnp.int64),
             limit=limit,
-            duration=np.full(batch, 3_600_000, dtype=np.int64),
+            duration=jnp.full((batch,), 3_600_000, jnp.int64),
             algo=algo,
             burst=limit,
-            reset_remaining=np.zeros(batch, dtype=bool),
-            is_greg=np.zeros(batch, dtype=bool),
-            greg_expire=np.zeros(batch, dtype=np.int64),
-            greg_duration=np.zeros(batch, dtype=np.int64),
+            reset_remaining=zb,
+            is_greg=zb,
+            greg_expire=zi,
+            greg_duration=zi,
             active=active,
-            use_cached=np.zeros(batch, dtype=bool),
+            use_cached=zb,
         )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def populate(tbl, keys2d, now_):
+        """Insert every key row of keys2d [n_chunks, batch] in ONE device
+        program (lax.scan) — one compile, one dispatch, no per-chunk host
+        round-trips.  On remote-device rigs the per-dispatch tunnel cost
+        varies wildly run to run; a python loop of 39 donated-table steps
+        measured anywhere from seconds to tens of minutes."""
+
+        def body(t, ks):
+            t, _ = apply_batch_impl(t, batch_from_keys(ks), now_, ways)
+            return t, None
+
+        tbl, _ = lax.scan(body, tbl, keys2d)
+        return tbl
 
     dev = jax.devices()[0]
     with jax.default_device(dev):
         table = init_table(num_slots)
+    _phase("table initialized (%d slots)" % num_slots)
 
     now = np.int64(now0)
-    # Populate: insert all 10M keys so the measured steady state runs
-    # against a full-size live working set (~60% table load factor).
-    for s in range(0, n_keys, batch):
-        db = DeviceBatchJ(
-            *[jax.device_put(a, dev) for a in make_batch(key_pool[s:s + batch])]
-        )
-        table, resp = apply_batch(table, db, now, ways=ways)
-    jax.block_until_ready(resp.status)
+    # Populate: insert all keys so the measured steady state runs against
+    # a full-size live working set (~60% table load factor at defaults).
+    n_chunks = (n_keys + batch - 1) // batch
+    keys_padded = np.zeros(n_chunks * batch, dtype=np.int64)
+    keys_padded[:n_keys] = key_pool
+    keys2d = jax.device_put(keys_padded.reshape(n_chunks, batch), dev)
+    jax.block_until_ready(keys2d)
+    _phase("key columns uploaded (%.0f MB)" % (keys_padded.nbytes / 1e6))
+    table = populate(table, keys2d, now)
+    jax.block_until_ready(table.key)
+    del keys2d
+    _phase("populate done (%d keys, %d chunks)" % (n_keys, n_chunks))
 
-    # Staged measurement batches: unique keys per batch, drawn uniformly
-    # from the full 10M-key pool (permutation slices).
-    perm = rng.permutation(n_keys)
-    staged = [
-        DeviceBatchJ(
-            *[
-                jax.device_put(a, dev)
-                for a in make_batch(key_pool[perm[i * batch: (i + 1) * batch]])
-            ]
+    # Staged measurement batches: unique keys WITHIN each batch (the
+    # steady state measured is the unique-key path, not the intra-batch
+    # duplicate cascade), drawn uniformly from the full key pool.  Rows
+    # are sampled independently so the property holds even when the pool
+    # is smaller than n_staged * batch.
+    if n_keys < batch:
+        raise SystemExit(
+            "BENCH_KEYS (%d) must be >= BENCH_BATCH (%d) for unique "
+            "per-batch sampling" % (n_keys, batch)
         )
+    staged_idx = np.stack([
+        rng.choice(n_keys, size=batch, replace=False)
+        for _ in range(n_staged)
+    ])
+    expand = jax.jit(batch_from_keys)
+    staged = [
+        expand(jax.device_put(key_pool[staged_idx[i]], dev))
         for i in range(n_staged)
     ]
+    jax.block_until_ready(staged[-1].key_hash)
+    _phase("staged batches built on device")
     for i in range(2):  # warm the measurement shape
         table, resp = apply_batch(table, staged[i], now, ways=ways)
     jax.block_until_ready(resp.status)
+    _phase("warmup done")
 
     # Timed: run for ~2 seconds of wall time.
     iters = 0
@@ -129,10 +177,14 @@ def main() -> None:
     jax.block_until_ready(resp.status)
     elapsed = time.perf_counter() - t0
     value = batch * iters / elapsed
+    _phase("kernel metric done (%d iters, %.2fs)" % (iters, elapsed))
 
     # FED companion: fresh packed request upload + packed response fetch
     # per step (apply_batch_packed_q, the service-drain shape), double
-    # buffered — dispatch step i+1 before fetching response i.
+    # buffered — dispatch step i+1 before fetching response i.  Non-fatal:
+    # on a degraded tunnel the fetches can stall for minutes; the headline
+    # kernel metric must still print, so failures/timeouts are reported in
+    # fed_error instead of killing the run.
     from gubernator_tpu.ops.step import apply_batch_packed_q
 
     def pack_q(ks: np.ndarray) -> np.ndarray:
@@ -147,32 +199,78 @@ def main() -> None:
         q[10, :m] = 1
         return q
 
-    host_qs = [
-        pack_q(key_pool[perm[i * batch: (i + 1) * batch]])
-        for i in range(n_staged)
-    ]
-    table2, r = apply_batch_packed_q(
-        table, jax.device_put(host_qs[0]), now, ways=ways
+    # Watchdog: the budget must fire even while a transfer is BLOCKED in
+    # a C call (an inline clock check between iterations never runs while
+    # np.asarray/device_put is stalled).  SIGALRM interrupts the wait and
+    # raises in the main thread; best-effort — a C call that never yields
+    # the GIL can still defer it, but slow-yet-progressing transfers are
+    # interrupted where the inline check alone would not be reached.
+    import signal
+
+    import math
+
+    # ceil: a fractional budget must not truncate to signal.alarm(0),
+    # which would CANCEL the alarm instead of arming it.
+    fed_budget_s = max(
+        1, math.ceil(float(os.environ.get("BENCH_FED_BUDGET_S", 120)))
     )
-    np.asarray(r)  # warm the shape + the transfer path
-    fed_iters = 0
-    pending = None
-    t0 = time.perf_counter()
-    deadline = t0 + 2.0
-    while time.perf_counter() < deadline or pending is not None:
-        if time.perf_counter() < deadline:
-            q_dev = jax.device_put(host_qs[fed_iters % n_staged])
-            table2, r = apply_batch_packed_q(table2, q_dev, now, ways=ways)
-            fed_iters += 1
-            nxt = r
-        else:
-            nxt = None
-        if pending is not None:
-            np.asarray(pending)  # the previous step's full response
-        pending = nxt
-    fed_elapsed = time.perf_counter() - t0
-    fed_value = batch * fed_iters / fed_elapsed
-    bytes_per_decision = (12 + 9) * 8
+
+    def _fed_alarm(signum, frame):  # noqa: ARG001
+        raise TimeoutError("fed phase exceeded BENCH_FED_BUDGET_S")
+
+    fed: dict = {}
+    old_alarm = signal.signal(signal.SIGALRM, _fed_alarm)
+    signal.alarm(fed_budget_s)
+    try:
+        host_qs = [pack_q(key_pool[staged_idx[i]]) for i in range(n_staged)]
+        table2, r = apply_batch_packed_q(
+            table, jax.device_put(host_qs[0], dev), now, ways=ways
+        )
+        np.asarray(r)  # warm the shape + the transfer path
+        _phase("fed warmup done")
+        fed_iters = 0
+        pending = None
+        t0 = time.perf_counter()
+        deadline = t0 + 2.0
+        while time.perf_counter() < deadline or pending is not None:
+            if time.perf_counter() < deadline:
+                q_dev = jax.device_put(host_qs[fed_iters % n_staged], dev)
+                table2, r = apply_batch_packed_q(
+                    table2, q_dev, now, ways=ways
+                )
+                fed_iters += 1
+                nxt = r
+            else:
+                nxt = None
+            if pending is not None:
+                np.asarray(pending)  # the previous step's full response
+            pending = nxt
+        fed_elapsed = time.perf_counter() - t0
+        fed_value = batch * fed_iters / fed_elapsed
+        _phase("fed metric done (%d iters, %.2fs)" % (fed_iters, fed_elapsed))
+        bytes_per_decision = (12 + 9) * 8
+        fed = {
+            "fed_decisions_per_sec": round(fed_value, 1),
+            "fed_vs_baseline": round(fed_value / 12.5e6, 4),
+            "fed_link_bytes_per_decision": bytes_per_decision,
+            "fed_implied_link_MBps": round(
+                fed_value * bytes_per_decision / 1e6, 1
+            ),
+            "fed_note": (
+                "per-step H2D request upload + D2H response fetch "
+                "(apply_batch_packed_q), double-buffered; on a "
+                "remote-device tunnel this measures the host link, "
+                "not the chip — scale by a co-located link's "
+                "bandwidth via fed_link_bytes_per_decision"
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 — fed is best-effort, LABELED
+        _phase("fed metric FAILED: %r" % (e,))
+        fed = {"fed_error": "%s: %s" % (type(e).__name__, e)}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_alarm)
+
     print(
         json.dumps(
             {
@@ -180,19 +278,7 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "decisions/s",
                 "vs_baseline": round(value / 12.5e6, 4),
-                "fed_decisions_per_sec": round(fed_value, 1),
-                "fed_vs_baseline": round(fed_value / 12.5e6, 4),
-                "fed_link_bytes_per_decision": bytes_per_decision,
-                "fed_implied_link_MBps": round(
-                    fed_value * bytes_per_decision / 1e6, 1
-                ),
-                "fed_note": (
-                    "per-step H2D request upload + D2H response fetch "
-                    "(apply_batch_packed_q), double-buffered; on a "
-                    "remote-device tunnel this measures the host link, "
-                    "not the chip — scale by a co-located link's "
-                    "bandwidth via fed_link_bytes_per_decision"
-                ),
+                **fed,
             }
         )
     )

@@ -63,7 +63,14 @@ def main() -> None:
 
     which = sys.argv[1] if len(sys.argv) > 1 else "mixed"
     lo = int(sys.argv[2]) if len(sys.argv) > 2 else 0
-    hi = int(sys.argv[3]) if len(sys.argv) > 3 else lo + 10
+    # Default depth when no explicit hi: 10 seeds (2 for the slow wire
+    # sweeps).  An explicit hi is honored exactly — never widened.
+    if len(sys.argv) > 3:
+        hi = int(sys.argv[3])
+    elif which in ("routed", "mesh"):
+        hi = lo + 2
+    else:
+        hi = lo + 10
     if which == "mixed":
         for s in range(lo, hi):
             clock_mod.freeze()
@@ -80,7 +87,7 @@ def main() -> None:
             print(f"store seed {s} ok", flush=True)
     elif which == "routed":
         for ph in ("xx", "fnv1", "fnv1a"):
-            for s in range(lo, max(hi, lo + 2)):
+            for s in range(lo, hi):
                 _with_seed(
                     s, tf.test_multinode_routed_wire_differential, ph
                 )
