@@ -53,8 +53,12 @@ artifact = {
         "co-located latency bound separates the python grpc.aio client's "
         "own machinery (~1.3ms p50 of the wire loopback) from the "
         "server-side handler path (~30us p50), and measures device "
-        "execution in a fetch-free subprocess.  Tunnel throughput "
-        "varies +-30% run to run."
+        "execution in a fetch-free subprocess.  The GLOBAL accounting "
+        "also reports the shared-chip normalization: all 4 daemons of "
+        "the global_4peer cluster run against this rig's ONE device, so "
+        "the measured global/exact ratio includes cross-daemon device-"
+        "queue interleave that a chip-per-daemon deployment does not "
+        "pay.  Tunnel throughput varies +-30% run to run."
     ),
     "results": results,
 }
