@@ -130,12 +130,20 @@ def main() -> None:
 
     now = np.int64(now0)
     # Misconfiguration must die BEFORE the populate phase — over a
-    # degraded tunnel that phase can take minutes.
+    # degraded tunnel that phase can take minutes.  That includes the fed
+    # companion's knob: parsed here so a bad value can't kill the run
+    # after the kernel metric was already paid for.
     if n_keys < batch:
         raise SystemExit(
             "BENCH_KEYS (%d) must be >= BENCH_BATCH (%d) for unique "
             "per-batch sampling" % (n_keys, batch)
         )
+    try:
+        fed_batch = min(batch, int(os.environ.get("BENCH_FED_BATCH", 4096)))
+    except ValueError as e:
+        raise SystemExit("BENCH_FED_BATCH must be an integer: %s" % e)
+    if fed_batch < 1:
+        raise SystemExit("BENCH_FED_BATCH must be >= 1 (got %d)" % fed_batch)
     # Populate: insert all keys so the measured steady state runs against
     # a full-size live working set (~60% table load factor at defaults).
     n_chunks = (n_keys + batch - 1) // batch
@@ -231,7 +239,7 @@ def main() -> None:
     # operating point: the metric exists to price per-step feeding, and
     # a 262k-lane upload is ~25MB/step — minutes per step on a degraded
     # tunnel, which is how the r4 fed phase timed out.
-    fed_batch = min(batch, int(os.environ.get("BENCH_FED_BATCH", 4096)))
+    # fed_batch was parsed/validated before the populate phase.
     bytes_per_decision = (12 + 9) * 8
     # Packed at fed_batch width directly: contiguous arrays for the timed
     # device_put loop (a [:, :fed_batch] slice of a full-batch pack would

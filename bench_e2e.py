@@ -107,7 +107,9 @@ def bench(seconds: float, concurrency: int) -> None:
     grpc.aio multiplexes one poller per process, and a second event loop
     polling it (server on the cluster loop, clients on another) thrashes
     into BlockingIOError storms and 30x latency."""
-    from gubernator_tpu.core.config import DeviceConfig, SketchTierConfig
+    from gubernator_tpu.core.config import (
+        DaemonConfig, DeviceConfig, SketchTierConfig,
+    )
     from gubernator_tpu.testing.cluster import Cluster
 
     import jax
@@ -120,6 +122,19 @@ def bench(seconds: float, concurrency: int) -> None:
         dev_cfg = DeviceConfig(num_slots=1 << 18, ways=8, batch_size=4096)
     else:
         dev_cfg = DeviceConfig(num_slots=1 << 22, ways=8, batch_size=4096)
+    # Honor the daemon's drain-policy env knob so A/B artifacts (shipped
+    # sparse=64 vs sparse=0) run the exact same harness (the real daemon
+    # reads it in setup_daemon_config; Cluster builds DaemonConfig
+    # directly, so mirror the one knob the A/B varies through the same
+    # parse/validate).  Cluster.start_with's `device=` argument is the
+    # single source of the device config — the template leaves it alone.
+    from gubernator_tpu.core.config import fastpath_sparse_from_env
+
+    sparse = fastpath_sparse_from_env()
+
+    def conf(**kw) -> DaemonConfig:
+        return DaemonConfig(fastpath_sparse=sparse, **kw)
+
     rng = np.random.default_rng(7)
     results = []
 
@@ -140,7 +155,7 @@ def bench(seconds: float, concurrency: int) -> None:
         print(json.dumps(line), flush=True)
 
     # ---- configs 1/2/4: single-node daemon (compiled fast lane) -------
-    c = Cluster.start_with([""], device=dev_cfg)
+    c = Cluster.start_with([""], device=dev_cfg, conf_template=conf())
     try:
         addr = [c.daemons[0].grpc_address]
 
@@ -371,13 +386,10 @@ def bench(seconds: float, concurrency: int) -> None:
     # residency probe + one packed capture gather + per-unique-key
     # on_change delivery.  Must land within ~2x of the storeless token
     # config.
-    from gubernator_tpu.core.config import DaemonConfig
-
     try:
         from gubernator_tpu.runtime.store import MockStore
 
-        store_conf = DaemonConfig(device=dev_cfg)
-        store_conf.store = MockStore()
+        store_conf = conf(store=MockStore())
         c = Cluster.start_with(
             [""], device=dev_cfg, conf_template=store_conf
         )
@@ -408,7 +420,9 @@ def bench(seconds: float, concurrency: int) -> None:
 
     # ---- config 3: GLOBAL on a 4-daemon cluster -----------------------
     try:
-        c = Cluster.start_with(["", "", "", ""], device=dev_cfg)
+        c = Cluster.start_with(
+            ["", "", "", ""], device=dev_cfg, conf_template=conf()
+        )
         try:
             from gubernator_tpu.core.types import Behavior
 
@@ -493,11 +507,8 @@ def bench(seconds: float, concurrency: int) -> None:
     # kernel's XLA compile over a remote-device tunnel exceeds the
     # cluster boot timeout; its device-side number is measured by
     # cli/microbench.py instead (use_pallas=False here). ----------------
-    from gubernator_tpu.core.config import DaemonConfig
-
     try:
-        sketch_conf = DaemonConfig(
-            device=dev_cfg,
+        sketch_conf = conf(
             sketch=SketchTierConfig(
                 names=["cms"], width=1 << 20, depth=4, window_ms=60_000,
                 use_pallas=False,
@@ -532,6 +543,7 @@ def bench(seconds: float, concurrency: int) -> None:
     summary = {
         "config": "summary",
         "platform": platform,
+        "fastpath_sparse": sparse,
         "device": {
             "num_slots": dev_cfg.num_slots,
             "batch_size": dev_cfg.batch_size,

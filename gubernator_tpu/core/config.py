@@ -260,6 +260,16 @@ def load_config_file(path: str) -> None:
             os.environ[k.strip()] = val.strip()
 
 
+def fastpath_sparse_from_env() -> int:
+    """The sparse-overlap drain knob, parsed/validated exactly as the
+    daemon does — the public entry for harnesses (bench_e2e) that build
+    DaemonConfig directly but must honor the same env override."""
+    return _require_min(
+        "GUBER_FASTPATH_SPARSE",
+        _env_int("GUBER_FASTPATH_SPARSE", 64), 0,
+    )
+
+
 def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     """Build a DaemonConfig from GUBER_* env vars (config.go:253-459)."""
     if config_file:
@@ -347,10 +357,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             "GUBER_FASTPATH_INFLIGHT",
             _env_int("GUBER_FASTPATH_INFLIGHT", 1), 1,
         ),
-        fastpath_sparse=_require_min(
-            "GUBER_FASTPATH_SPARSE",
-            _env_int("GUBER_FASTPATH_SPARSE", 64), 0,
-        ),
+        fastpath_sparse=fastpath_sparse_from_env(),
     )
 
 

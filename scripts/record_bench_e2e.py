@@ -1,14 +1,21 @@
 """Run bench_e2e on the rig and assemble BENCH_E2E_r{N}.json.
 
 Usage: python scripts/record_bench_e2e.py [seconds] [concurrency] [round]
+                                          [suffix]
+
+A non-empty `suffix` names a variant artifact (BENCH_E2E_r{N}_{suffix}
+.json) for A/B runs; the GUBER_FASTPATH_SPARSE env var passes through to
+bench_e2e's cluster configs.
 """
 import json
+import os
 import subprocess
 import sys
 
 SECONDS = sys.argv[1] if len(sys.argv) > 1 else "5"
 CONC = sys.argv[2] if len(sys.argv) > 2 else "16"
 ROUND = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+SUFFIX = sys.argv[4] if len(sys.argv) > 4 else ""
 
 out = subprocess.run(
     [sys.executable, "/root/repo/bench_e2e.py", "--seconds", SECONDS,
@@ -62,7 +69,17 @@ artifact = {
     ),
     "results": results,
 }
-out_path = "/root/repo/BENCH_E2E_r%02d.json" % ROUND
+if SUFFIX:
+    artifact["variant"] = SUFFIX
+if "GUBER_FASTPATH_SPARSE" in os.environ:
+    # Record the override wherever it was applied — a suffix-less run
+    # with the knob set must not masquerade as a default-config artifact.
+    artifact["harness"] += "  [env GUBER_FASTPATH_SPARSE=%s]" % (
+        os.environ["GUBER_FASTPATH_SPARSE"],
+    )
+out_path = "/root/repo/BENCH_E2E_r%02d%s.json" % (
+    ROUND, ("_" + SUFFIX) if SUFFIX else "",
+)
 with open(out_path, "w") as f:
     json.dump(artifact, f, indent=1)
 print("wrote", out_path, "with", len(results), "results")
