@@ -130,6 +130,21 @@ def test_env_config(monkeypatch):
     assert cfg.peer_discovery_type == "static"
 
 
+def test_fastpath_sparse_env(monkeypatch):
+    """The public sparse-knob parser (used by bench_e2e so A/B harness
+    runs share the daemon's own parse) matches setup_daemon_config."""
+    from gubernator_tpu.core.config import fastpath_sparse_from_env
+
+    monkeypatch.delenv("GUBER_FASTPATH_SPARSE", raising=False)
+    assert fastpath_sparse_from_env() == 64
+    monkeypatch.setenv("GUBER_FASTPATH_SPARSE", "0")
+    assert fastpath_sparse_from_env() == 0
+    assert setup_daemon_config().fastpath_sparse == 0
+    monkeypatch.setenv("GUBER_FASTPATH_SPARSE", "-1")
+    with pytest.raises(ValueError):
+        fastpath_sparse_from_env()
+
+
 def test_device_config_validation():
     with pytest.raises(ValueError):
         DeviceConfig(num_slots=100, ways=8)
