@@ -283,6 +283,30 @@ def bench(seconds: float, concurrency: int) -> None:
                 lats.append(time.perf_counter() - t0)
             return lats
 
+        async def start_echo_server():
+            """A bare grpc.aio byte-echo server on THIS loop.  Driving
+            it with the same drive() harness as the daemon loopback
+            (fresh channels, duration-based sampling, same payload and
+            concurrency) measures the floor the daemon's wire numbers
+            sit on — identical client machinery on both sides of the
+            loopback-minus-floor subtraction, cold-start included."""
+            import grpc
+            import grpc.aio
+
+            async def echo(request, context):  # noqa: ARG001
+                return request
+
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "echo.Echo",
+                    {"Ping": grpc.unary_unary_rpc_method_handler(echo)},
+                ),
+            ))
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            return server, port
+
         turnaround_ms = merge_cycle_ms()
         exec_ms, exec_src = clean_exec_ms()
         # Wire loopback WITHOUT the device: an empty GetRateLimitsReq
@@ -293,6 +317,16 @@ def bench(seconds: float, concurrency: int) -> None:
         _, lb_lat = c.run(drive(addr, [empty], 2.0, 4), timeout=120)
         lb50, lb99 = _percentiles(lb_lat)
         h50, h99 = _percentiles(c.run(handler_only(), timeout=120))
+        echo_server, echo_port = c.run(start_echo_server(), timeout=120)
+        try:
+            _, fl_lat = c.run(
+                drive(["127.0.0.1:%d" % echo_port], [empty], 2.0, 4,
+                      method="/echo.Echo/Ping"),
+                timeout=120,
+            )
+        finally:
+            c.run(echo_server.stop(0), timeout=30)
+        f50, f99 = _percentiles(fl_lat)
         lat_line = next(
             r for r in results if r["config"] == "latency_small_batch"
         )
@@ -308,6 +342,9 @@ def bench(seconds: float, concurrency: int) -> None:
                 "path alone + a 0.1ms transport allowance — the "
                 "reference's own '<1ms for most batched responses' is "
                 "observed by compiled Go clients (README.md:98-104).  "
+                "grpc_aio_floor is the same payload through a bare "
+                "grpc.aio byte-echo pair on the same loop; the loopback "
+                "tail above that floor is what the framework adds.  "
                 "exec is true device execution from a fetch-free "
                 "subprocess; the rig's sticky post-fetch dispatch mode "
                 "(and its ~70-300ms fetch turnaround) is what "
@@ -315,6 +352,15 @@ def bench(seconds: float, concurrency: int) -> None:
             ),
             "wire_loopback_p50_ms": round(lb50, 3),
             "wire_loopback_p99_ms": round(lb99, 3),
+            # Same payload through a bare grpc.aio byte-echo pair on the
+            # same loop, driven by the same drive() harness: the floor
+            # the daemon's wire numbers sit on.  Only the median
+            # difference is emitted as "overhead" — p99s of short
+            # independent runs are too noisy to subtract (the tails are
+            # shown side by side instead).
+            "grpc_aio_floor_p50_ms": round(f50, 3),
+            "grpc_aio_floor_p99_ms": round(f99, 3),
+            "framework_wire_overhead_p50_ms": round(lb50 - f50, 3),
             "handler_p50_ms": round(h50, 3),
             "handler_p99_ms": round(h99, 3),
             "device_step_exec_ms": round(exec_ms, 3),
