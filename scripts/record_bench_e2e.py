@@ -17,13 +17,26 @@ CONC = sys.argv[2] if len(sys.argv) > 2 else "16"
 ROUND = int(sys.argv[3]) if len(sys.argv) > 3 else 5
 SUFFIX = sys.argv[4] if len(sys.argv) > 4 else ""
 
-out = subprocess.run(
-    [sys.executable, "/root/repo/bench_e2e.py", "--seconds", SECONDS,
-     "--concurrency", CONC],
-    capture_output=True, text=True, timeout=1800,
-)
+try:
+    out = subprocess.run(
+        [sys.executable, "/root/repo/bench_e2e.py", "--seconds", SECONDS,
+         "--concurrency", CONC],
+        capture_output=True, text=True, timeout=1800,
+    )
+    stdout = out.stdout
+except subprocess.TimeoutExpired as e:
+    # A dark device tunnel hangs bench_e2e rather than erroring; keep
+    # whatever configs completed before the budget (partial artifact
+    # with the timeout labeled) instead of crashing with no artifact.
+    out = None
+    stdout = (e.stdout or b"").decode() if isinstance(
+        e.stdout, bytes) else (e.stdout or "")
+    stdout += (
+        '\n{"config": "recorder_timeout", "error": '
+        '"bench_e2e exceeded 1800s (device tunnel dark?)"}'
+    )
 results = []
-for line in out.stdout.splitlines():
+for line in stdout.splitlines():
     line = line.strip()
     if line.startswith("{"):
         try:
@@ -31,7 +44,9 @@ for line in out.stdout.splitlines():
         except json.JSONDecodeError:
             pass
 if not results:
-    sys.stderr.write(out.stdout[-2000:] + "\n" + out.stderr[-4000:] + "\n")
+    sys.stderr.write(
+        stdout[-2000:] + "\n" + (out.stderr[-4000:] if out else "") + "\n"
+    )
     raise SystemExit("no results parsed")
 
 artifact = {
