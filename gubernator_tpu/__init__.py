@@ -28,3 +28,18 @@ from gubernator_tpu.core.types import (  # noqa: F401
     HealthCheckResp,
     has_behavior,
 )
+
+
+def __getattr__(name: str):
+    """Lazy top-level client SDK (keeps `import gubernator_tpu` free of
+    grpc; the reference's Go package exposes its client the same
+    flat way, client.go:42-63)."""
+    if name in ("V1Client", "AsyncV1Client"):
+        from gubernator_tpu import client
+
+        return getattr(client, name)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(list(globals()) + ["V1Client", "AsyncV1Client"])
