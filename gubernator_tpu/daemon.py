@@ -195,6 +195,8 @@ class Daemon:
         self.service: Optional[Service] = None
         self.fastpath = None
         self._grpc_server: Optional[grpc.aio.Server] = None
+        self._grpc_tls_proxy = None  # net.tls.TLSTerminatingProxy
+        self._grpc_backend_dir: Optional[str] = None
         self._http_runner: Optional[web.AppRunner] = None
         self._pool = None
         self._peers: List[PeerInfo] = []
@@ -250,22 +252,49 @@ class Daemon:
             grpc_api.v1_generic_handler(_V1Servicer(self), raw=True),
             grpc_api.peers_generic_handler(_PeersServicer(self), raw=True),
         ))
-        if self.tls is not None:
+        from gubernator_tpu.net.tls import OPTIONAL_MODES
+
+        proxy_auth = (
+            self.tls is not None
+            and self.tls.client_auth in OPTIONAL_MODES
+        )
+        if proxy_auth:
+            # Optional client-auth (request / verify-if-given): grpc's
+            # credentials can't request-without-require a client cert,
+            # so terminate TLS in-process (ssl.CERT_OPTIONAL, ALPN h2)
+            # and pipe plaintext HTTP/2 to an insecure gRPC listener on
+            # a unix socket in a 0700 tempdir — NOT a loopback TCP port,
+            # which would let any local process bypass TLS/client-auth.
+            import tempfile
+
+            self._grpc_backend_dir = tempfile.mkdtemp(prefix="gubtpu-grpc-")
+            bound = "unix:%s/backend.sock" % self._grpc_backend_dir
+            port = server.add_insecure_port(bound)
+        elif self.tls is not None:
+            bound = self.conf.grpc_listen_address
             port = server.add_secure_port(
-                self.conf.grpc_listen_address,
-                self.tls.server_credentials(),
+                bound, self.tls.server_credentials(),
             )
         else:
-            port = server.add_insecure_port(self.conf.grpc_listen_address)
+            bound = self.conf.grpc_listen_address
+            port = server.add_insecure_port(bound)
         if port == 0:
-            raise RuntimeError(
-                f"failed to bind {self.conf.grpc_listen_address}"
-            )
-        # Rewrite :0 ephemeral binds to the actual port for advertisement.
+            raise RuntimeError(f"failed to bind {bound}")
         host = self.conf.grpc_listen_address.rpartition(":")[0]
-        self.grpc_address = f"{host}:{port}"
         await server.start()
         self._grpc_server = server
+        if proxy_auth:
+            from gubernator_tpu.net.tls import TLSTerminatingProxy
+
+            self._grpc_tls_proxy = TLSTerminatingProxy(
+                self.tls.grpc_proxy_ssl_context(),
+                "%s/backend.sock" % self._grpc_backend_dir,
+            )
+            port = await self._grpc_tls_proxy.start(
+                self.conf.grpc_listen_address
+            )
+        # Rewrite :0 ephemeral binds to the actual port for advertisement.
+        self.grpc_address = f"{host}:{port}"
 
         await self._start_http()
         await self._start_discovery()
@@ -281,9 +310,23 @@ class Daemon:
         if self._pool is not None:
             await self._pool.close()
             self._pool = None
+        if self._grpc_tls_proxy is not None:
+            # Refuse NEW connections on the real socket before the gRPC
+            # drain (a mid-shutdown dial must see connection-refused, not
+            # a handshake onto a dying backend); live pipes keep flowing
+            # through the grace below, then get cut.
+            await self._grpc_tls_proxy.stop_accepting()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=1.0)
             self._grpc_server = None
+        if self._grpc_tls_proxy is not None:
+            await self._grpc_tls_proxy.close()
+            self._grpc_tls_proxy = None
+        if self._grpc_backend_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._grpc_backend_dir, ignore_errors=True)
+            self._grpc_backend_dir = None
         if self._http_runner is not None:
             await self._http_runner.cleanup()
             self._http_runner = None

@@ -15,22 +15,23 @@ Client-auth mode mapping (reference config.go:348-362, tls.go:140-238):
 
 | Go mode                     | here              | gRPC / ssl behavior    |
 |-----------------------------|-------------------|------------------------|
-| request                     | "request"         | HTTPS gateway: cert
-|                             |                   | optional, verified if
-|                             |                   | presented; gRPC: not
-|                             |                   | requested (see below)  |
+| request                     | "request"         | cert optional, verified
+|                             |                   | if presented (both
+|                             |                   | listeners)             |
 | verify-if-given             | "verify-if-given" | same as "request"      |
 | require-any                 | "require-any"     | cert required AND
 |                             |                   | verified (python cannot
 |                             |                   | require-without-verify)|
 | require-and-verify          | "require"/"verify"| cert required+verified |
 
-The required rows are exact or strictly STRICTER than Go's.  The
-optional rows are exact on the HTTPS gateway (ssl.CERT_OPTIONAL) but
-grpc-python's credentials API has no request-without-require option, so
-on the gRPC listener optional modes cannot request a cert at all —
-setup_tls logs a warning; use a required mode when gRPC-side client
-identity matters.
+Every row is exact or strictly STRICTER than Go's.  The optional rows
+use ssl.CERT_OPTIONAL — directly on the HTTPS gateway, and on the gRPC
+listener via `TLSTerminatingProxy`: grpc-python's credentials API has
+no request-without-require option, so for optional modes the daemon
+terminates TLS itself (python ssl, ALPN h2) and pipes plaintext HTTP/2
+to an insecure gRPC listener on a private unix socket.  "Strictly stricter" = Go's `request`
+ignores an unverifiable presented cert; here a presented cert must
+chain to the CA or the handshake fails.
 """
 from __future__ import annotations
 
@@ -121,6 +122,120 @@ class TLSBundle:
             ctx.verify_mode = ssl.CERT_OPTIONAL
         return ctx
 
+    def grpc_proxy_ssl_context(self) -> ssl.SSLContext:
+        """Server context for the gRPC TLS-terminating proxy (optional
+        client-auth modes only): python ssl CAN express
+        request-without-require (CERT_OPTIONAL), which grpc-python's
+        credentials API cannot — so the daemon terminates TLS itself and
+        pipes plaintext HTTP/2 to an insecure gRPC listener on a private
+        unix socket.
+        ALPN must advertise h2: gRPC clients refuse a TLS server that
+        doesn't negotiate it."""
+        ctx = self.server_ssl_context()
+        ctx.set_alpn_protocols(["h2"])
+        return ctx
+
+
+class TLSTerminatingProxy:
+    """Byte-level TLS terminator in front of an insecure gRPC listener
+    on a private unix socket.  Exists for the optional client-auth modes
+    (request / verify-if-given, tls.go VerifyClientCertIfGiven): the
+    handshake requests a client certificate without requiring one and
+    verifies it only when presented — semantics grpc-python's boolean
+    require_client_auth cannot express.  HTTP/2 passes through untouched
+    (the proxy never parses frames), so the gRPC server behind it serves
+    the exact same wire bytes."""
+
+    def __init__(self, ssl_ctx: ssl.SSLContext,
+                 backend_unix_path: str) -> None:
+        # The plaintext backend is a UNIX socket in a 0700 directory, not
+        # a loopback TCP port: a TCP backend would hand any local process
+        # a side door around TLS and client-auth entirely.
+        self._ctx = ssl_ctx
+        self._backend_path = backend_unix_path
+        self._server: Optional[object] = None
+        self._conns: set = set()
+
+    async def start(self, listen_address: str) -> int:
+        """Bind and return the bound port.  Accepts the grpc address
+        forms the secure-port path accepts: host:port (port may be 0),
+        bracketed IPv6 ([::]:port), and unix:path (returns 1, grpc's
+        own convention for portless binds)."""
+        import asyncio
+
+        if listen_address.startswith("unix:"):
+            self._server = await asyncio.start_unix_server(
+                self._handle, listen_address[len("unix:"):], ssl=self._ctx
+            )
+            return 1
+        host, _, port = listen_address.rpartition(":")
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]
+        self._server = await asyncio.start_server(
+            self._handle, host or "0.0.0.0", int(port), ssl=self._ctx
+        )
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, creader, cwriter) -> None:
+        import asyncio
+
+        task = asyncio.current_task()
+        self._conns.add(task)
+        breader = bwriter = None
+        try:
+            breader, bwriter = await asyncio.open_unix_connection(
+                self._backend_path
+            )
+
+            async def pump(src, dst) -> None:
+                while True:
+                    data = await src.read(1 << 16)
+                    if not data:
+                        break
+                    dst.write(data)
+                    await dst.drain()
+                if dst.can_write_eof():
+                    dst.write_eof()
+
+            # return_exceptions: one direction failing (client reset)
+            # must not orphan the sibling pump — it runs to its own
+            # EOF/error and is awaited here either way.
+            await asyncio.gather(
+                pump(creader, bwriter), pump(breader, cwriter),
+                return_exceptions=True,
+            )
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass  # half-closed pipes at teardown are normal
+        finally:
+            for w in (bwriter, cwriter):
+                if w is not None:
+                    try:
+                        w.close()
+                    except Exception:  # noqa: BLE001 — teardown
+                        pass
+            self._conns.discard(task)
+
+    async def stop_accepting(self) -> None:
+        """Close the listener; live pipes keep flowing.  Call BEFORE the
+        gRPC server's drain grace so a client dialing mid-shutdown gets
+        connection-refused on the real socket rather than a handshake
+        that dies on a dead backend."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def close(self) -> None:
+        """Cut remaining pipes (after the gRPC drain grace has let
+        in-flight requests finish through them)."""
+        import asyncio
+
+        await self.stop_accepting()
+        for t in list(self._conns):
+            t.cancel()
+        if self._conns:
+            await asyncio.gather(*self._conns, return_exceptions=True)
+
 
 def setup_tls(
     cfg: Optional[TLSConfig],
@@ -140,10 +255,10 @@ def setup_tls(
     if cfg.client_auth in OPTIONAL_MODES:
         import logging
 
-        logging.getLogger("gubernator_tpu.tls").warning(
-            "client_auth=%r verifies presented certs on the HTTPS gateway "
-            "only; grpc-python cannot request-without-require, so the gRPC "
-            "listener will not ask clients for certificates",
+        logging.getLogger("gubernator_tpu.tls").info(
+            "client_auth=%r: gRPC optional client-auth served via the "
+            "in-process TLS terminator (grpc-python cannot "
+            "request-without-require; python ssl CERT_OPTIONAL can)",
             cfg.client_auth,
         )
     if cfg.cert_file and cfg.key_file:

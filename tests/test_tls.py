@@ -117,18 +117,20 @@ def test_tls_cluster_forwarding():
     assert len(owners) == 2, f"expected both peers serving, got {owners}"
 
 
-def test_grpc_optional_client_auth_divergence(caplog):
-    """Pin the DOCUMENTED divergence from the reference (tls.go:140-238):
-    grpc-python cannot request-a-cert-without-requiring-one, so on the
-    gRPC listener the optional modes (request / verify-if-given) do not
-    ask clients for certificates at all — a bare TLS client is served and
-    no client identity exists.  setup_tls must warn about exactly this.
-    The HTTPS gateway implements the optional modes faithfully
-    (test_https_gateway_client_auth); required modes are exact-or-
-    stricter on both listeners."""
-    import logging
+def test_grpc_optional_client_auth():
+    """Optional client-auth on the gRPC listener (tls.go
+    VerifyClientCertIfGiven), served via the in-process TLS terminator
+    (net.tls.TLSTerminatingProxy — grpc-python's credentials can't
+    request-without-require; python ssl CERT_OPTIONAL can):
 
-    ca_pem, ca_key_pem, _, _ = generate_auto_tls()
+    1. a BARE client (no certificate) is served;
+    2. a client presenting a cert from the daemon's CA is served;
+    3. a client presenting a cert from a FOREIGN CA fails the handshake
+       (verify-if-given; strictly stricter than Go's `request`, which
+       ignores unverifiable certs).
+    """
+    ca_pem, ca_key_pem, cert_pem, key_pem = generate_auto_tls()
+    foreign_ca, foreign_key, f_cert, f_key = generate_auto_tls()
     with tempfile.NamedTemporaryFile(suffix=".pem", delete=False) as caf, \
             tempfile.NamedTemporaryFile(
                 suffix=".pem", delete=False
@@ -137,39 +139,56 @@ def test_grpc_optional_client_auth_divergence(caplog):
         cakf.write(ca_key_pem)
 
     async def scenario() -> None:
-        with caplog.at_level(logging.WARNING, logger="gubernator_tpu.tls"):
-            d = Daemon(DaemonConfig(
-                grpc_listen_address="127.0.0.1:0",
-                http_listen_address="127.0.0.1:0",
-                behaviors=fast_test_behaviors(),
-                device=DEV,
-                tls=TLSConfig(
-                    client_auth="request",
-                    ca_file=caf.name, ca_key_file=cakf.name,
-                ),
-            ))
-            await d.start()
+        d = Daemon(DaemonConfig(
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="127.0.0.1:0",
+            behaviors=fast_test_behaviors(),
+            device=DEV,
+            tls=TLSConfig(
+                client_auth="verify-if-given",
+                ca_file=caf.name, ca_key_file=cakf.name,
+            ),
+        ))
+        await d.start()
         try:
-            assert any(
-                "cannot request-without-require" in r.message
-                for r in caplog.records
-            ), "setup_tls must warn about the gRPC optional-auth divergence"
-            # Bare client: server-auth TLS only, NO client certificate.
-            # The reference's `request` mode would ask for (and ignore a
-            # missing) cert; here the gRPC listener never asks, and the
-            # request is served — the documented degradation.
-            creds = grpc.ssl_channel_credentials(root_certificates=ca_pem)
-            ch = grpc.aio.secure_channel(d.grpc_address, creds)
-            stub = V1Stub(ch)
-            resp = await stub.GetRateLimits(pb.GetRateLimitsReq(
-                requests=[req_to_pb(RateLimitReq(
-                    name="tls_opt", unique_key="k", hits=1, limit=5,
-                    duration=60_000,
-                ))]
-            ))
+            assert d._grpc_tls_proxy is not None, (
+                "optional modes must route through the TLS terminator"
+            )
+
+            async def check(creds) -> pb.GetRateLimitsResp:
+                ch = grpc.aio.secure_channel(d.grpc_address, creds)
+                try:
+                    return await V1Stub(ch).GetRateLimits(
+                        pb.GetRateLimitsReq(requests=[req_to_pb(
+                            RateLimitReq(
+                                name="tls_opt", unique_key="k", hits=1,
+                                limit=5, duration=60_000,
+                            )
+                        )]),
+                        timeout=10,
+                    )
+                finally:
+                    await ch.close()
+
+            # 1. Bare client: optional means MAY connect without a cert.
+            resp = await check(grpc.ssl_channel_credentials(
+                root_certificates=ca_pem))
             assert resp.responses[0].error == ""
             assert resp.responses[0].remaining == 4
-            await ch.close()
+
+            # 2. Cert from the daemon's own CA: served.
+            resp = await check(grpc.ssl_channel_credentials(
+                root_certificates=ca_pem,
+                private_key=key_pem, certificate_chain=cert_pem))
+            assert resp.responses[0].error == ""
+            assert resp.responses[0].remaining == 3
+
+            # 3. Cert from a foreign CA: presented-but-unverifiable must
+            # FAIL the handshake (verify-if-given).
+            with pytest.raises(grpc.aio.AioRpcError):
+                await check(grpc.ssl_channel_credentials(
+                    root_certificates=ca_pem,
+                    private_key=f_key, certificate_chain=f_cert))
         finally:
             await d.close()
 
