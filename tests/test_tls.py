@@ -54,9 +54,12 @@ def test_auto_tls_shared_ca():
     assert b1.cert_pem != b2.cert_pem  # per-daemon certs
 
 
-def test_tls_cluster_forwarding():
+@pytest.mark.parametrize("client_auth", ["", "verify-if-given"])
+def test_tls_cluster_forwarding(client_auth):
     """A 2-node shared-CA TLS cluster forwards requests peer-to-peer over
-    TLS (tls_test.go:235)."""
+    TLS (tls_test.go:235).  The verify-if-given case routes every listener
+    through the TLS terminator, so peer forwards (which present certs)
+    exercise the proxy pipes under real cross-daemon traffic."""
     ca_pem, ca_key_pem, _, _ = generate_auto_tls()
 
     async def scenario():
@@ -74,43 +77,50 @@ def test_tls_cluster_forwarding():
                     behaviors=fast_test_behaviors(),
                     device=DEV,
                     tls=TLSConfig(
-                        ca_file=caf.name, ca_key_file=cakf.name
+                        ca_file=caf.name, ca_key_file=cakf.name,
+                        client_auth=client_auth,
                     ),
                 )
                 d = Daemon(conf)
                 await d.start()
                 d.conf.advertise_address = d.grpc_address
                 daemons.append(d)
-            peers = [
-                PeerInfo(grpc_address=d.grpc_address) for d in daemons
-            ]
-            for d in daemons:
-                await d.set_peers(peers)
+            try:
+                peers = [
+                    PeerInfo(grpc_address=d.grpc_address) for d in daemons
+                ]
+                for d in daemons:
+                    await d.set_peers(peers)
 
-            creds = grpc.ssl_channel_credentials(root_certificates=ca_pem)
-            ch = grpc.aio.secure_channel(
-                daemons[0].grpc_address, creds,
-                options=(
-                    ("grpc.ssl_target_name_override", "localhost"),
-                ),
-            )
-            stub = V1Stub(ch)
-            req = pb.GetRateLimitsReq(requests=[
-                req_to_pb(RateLimitReq(
-                    name="tls_test", unique_key=f"k{i}", hits=1,
-                    limit=10, duration=60_000,
-                ))
-                for i in range(64)
-            ])
-            resp = await stub.GetRateLimits(req)
-            owners = set()
-            for r in resp.responses:
-                assert r.error == ""
-                assert r.remaining == 9
-                owners.add(r.metadata.get("owner", "local"))
-            await ch.close()
-            for d in daemons:
-                await d.close()
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=ca_pem
+                )
+                ch = grpc.aio.secure_channel(
+                    daemons[0].grpc_address, creds,
+                    options=(
+                        ("grpc.ssl_target_name_override", "localhost"),
+                    ),
+                )
+                try:
+                    stub = V1Stub(ch)
+                    req = pb.GetRateLimitsReq(requests=[
+                        req_to_pb(RateLimitReq(
+                            name="tls_test", unique_key=f"k{i}", hits=1,
+                            limit=10, duration=60_000,
+                        ))
+                        for i in range(64)
+                    ])
+                    resp = await stub.GetRateLimits(req)
+                    owners = set()
+                    for r in resp.responses:
+                        assert r.error == ""
+                        assert r.remaining == 9
+                        owners.add(r.metadata.get("owner", "local"))
+                finally:
+                    await ch.close()
+            finally:
+                for d in daemons:
+                    await d.close()
             return owners
 
     owners = asyncio.run(scenario())
