@@ -74,8 +74,11 @@ artifact = {
         "config, and this artifact now tell one story; (4) the "
         "co-located latency bound separates the python grpc.aio client's "
         "own machinery (~1.3ms p50 of the wire loopback) from the "
-        "server-side handler path (~30us p50), and measures device "
-        "execution in a fetch-free subprocess.  The GLOBAL accounting "
+        "server-side handler path (~30us p50), measures device "
+        "execution in a fetch-free subprocess, and reports the bare "
+        "grpc.aio byte-echo floor under the loopback (grpc_aio_floor_*, "
+        "same payload, same drive() harness: loopback median minus floor "
+        "median = the framework's own wire overhead).  The GLOBAL accounting "
         "also reports the shared-chip normalization: all 4 daemons of "
         "the global_4peer cluster run against this rig's ONE device, so "
         "the measured global/exact ratio includes cross-daemon device-"
