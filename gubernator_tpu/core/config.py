@@ -207,9 +207,47 @@ class TLSConfig:
     key_file: str = ""
     # ""|request|verify-if-given|require-any|require-and-verify
     # (legacy "require"/"verify" == require-and-verify); see net/tls.py
-    # for the exact python mapping of the four Go modes.
+    # for the exact python mapping of the four Go modes.  The reference's
+    # spellings (config.go:351-354) are accepted as aliases by
+    # normalize_tls_client_auth.
     client_auth: str = ""
     insecure_skip_verify: bool = False
+
+
+# The reference daemon's GUBER_TLS_CLIENT_AUTH spellings
+# (config.go:351-354) -> this repo's canonical modes (net/tls.py).
+TLS_CLIENT_AUTH_ALIASES = {
+    "request-cert": "request",
+    "verify-cert": "verify-if-given",
+    "require-any-cert": "require-any",
+}
+TLS_CLIENT_AUTH_MODES = (
+    "",
+    "request",
+    "verify-if-given",
+    "require-any",
+    "require-and-verify",
+    # Legacy spellings of require-and-verify.
+    "require",
+    "verify",
+)
+
+
+def normalize_tls_client_auth(value: str) -> str:
+    """Canonicalize a client-auth mode, accepting the reference
+    spellings as aliases; raise on anything unknown (the reference
+    errors too, config.go:357-359) — a typo must not silently disable
+    client auth."""
+    v = (value or "").strip().lower()
+    v = TLS_CLIENT_AUTH_ALIASES.get(v, v)
+    if v not in TLS_CLIENT_AUTH_MODES:
+        raise ValueError(
+            f"unknown TLS client-auth mode {value!r}; expected one of "
+            + ", ".join(repr(m) for m in TLS_CLIENT_AUTH_MODES if m)
+            + " or a reference spelling "
+            + ", ".join(repr(m) for m in TLS_CLIENT_AUTH_ALIASES)
+        )
+    return v
 
 
 def _env(name: str, default: str = "") -> str:
@@ -297,7 +335,9 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             ca_key_file=_env("GUBER_TLS_CA_KEY"),
             cert_file=_env("GUBER_TLS_CERT"),
             key_file=_env("GUBER_TLS_KEY"),
-            client_auth=_env("GUBER_TLS_CLIENT_AUTH"),
+            client_auth=normalize_tls_client_auth(
+                _env("GUBER_TLS_CLIENT_AUTH")
+            ),
             insecure_skip_verify=_env("GUBER_TLS_INSECURE_SKIP_VERIFY") == "true",
         )
     static_peers = [

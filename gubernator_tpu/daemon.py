@@ -290,9 +290,23 @@ class Daemon:
                 self.tls.grpc_proxy_ssl_context(),
                 "%s/backend.sock" % self._grpc_backend_dir,
             )
-            port = await self._grpc_tls_proxy.start(
-                self.conf.grpc_listen_address
-            )
+            try:
+                port = await self._grpc_tls_proxy.start(
+                    self.conf.grpc_listen_address
+                )
+            except BaseException:
+                # The real listener never came up (port already bound,
+                # bad address): the daemon is NOT serving, so don't
+                # leave the insecure unix-socket backend and its 0700
+                # tempdir behind for a caller that may never close().
+                import shutil
+
+                self._grpc_tls_proxy = None
+                await server.stop(grace=None)
+                self._grpc_server = None
+                shutil.rmtree(self._grpc_backend_dir, ignore_errors=True)
+                self._grpc_backend_dir = None
+                raise
         # Rewrite :0 ephemeral binds to the actual port for advertisement.
         self.grpc_address = f"{host}:{port}"
 

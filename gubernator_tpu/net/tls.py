@@ -24,7 +24,11 @@ Client-auth mode mapping (reference config.go:348-362, tls.go:140-238):
 |                             |                   | require-without-verify)|
 | require-and-verify          | "require"/"verify"| cert required+verified |
 
-Every row is exact or strictly STRICTER than Go's.  The optional rows
+Every row is exact or strictly STRICTER than Go's.  The reference's
+spellings (`request-cert`, `verify-cert`, `require-any-cert` —
+config.go:351-354) are accepted as aliases and canonicalized by
+`core.config.normalize_tls_client_auth`; an UNKNOWN mode raises instead
+of silently disabling client auth.  The optional rows
 use ssl.CERT_OPTIONAL — directly on the HTTPS gateway, and on the gRPC
 listener via `TLSTerminatingProxy`: grpc-python's credentials API has
 no request-without-require option, so for optional modes the daemon
@@ -42,7 +46,7 @@ from typing import Optional, Tuple
 
 import grpc
 
-from gubernator_tpu.core.config import TLSConfig
+from gubernator_tpu.core.config import TLSConfig, normalize_tls_client_auth
 
 # Client certs required (and verified — python offers no
 # require-without-verify): Go's RequireAnyClientCert and
@@ -213,6 +217,17 @@ class TLSTerminatingProxy:
                         w.close()
                     except Exception:  # noqa: BLE001 — teardown
                         pass
+            for w in (bwriter, cwriter):
+                if w is not None:
+                    # Flush close_notify / final buffered bytes before the
+                    # transport is dropped — otherwise the client can see
+                    # an RST-style end instead of a clean TLS shutdown.
+                    try:
+                        await w.wait_closed()
+                    except asyncio.CancelledError:
+                        break  # close() is cutting pipes: stop waiting
+                    except Exception:  # noqa: BLE001 — teardown
+                        pass
             self._conns.discard(task)
 
     async def stop_accepting(self) -> None:
@@ -252,14 +267,18 @@ def setup_tls(
     """
     if cfg is None:
         return None
-    if cfg.client_auth in OPTIONAL_MODES:
+    # Canonicalize (reference spellings -> our modes) and REJECT unknown
+    # values: an unvalidated mode would match neither REQUIRED_MODES nor
+    # OPTIONAL_MODES and silently disable client auth.
+    client_auth = normalize_tls_client_auth(cfg.client_auth)
+    if client_auth in OPTIONAL_MODES:
         import logging
 
         logging.getLogger("gubernator_tpu.tls").info(
             "client_auth=%r: gRPC optional client-auth served via the "
             "in-process TLS terminator (grpc-python cannot "
             "request-without-require; python ssl CERT_OPTIONAL can)",
-            cfg.client_auth,
+            client_auth,
         )
     if cfg.cert_file and cfg.key_file:
         cert_pem = open(cfg.cert_file, "rb").read()
@@ -271,7 +290,7 @@ def setup_tls(
             ca_pem=ca_pem,
             cert_pem=cert_pem,
             key_pem=key_pem,
-            client_auth=cfg.client_auth,
+            client_auth=client_auth,
             insecure_skip_verify=cfg.insecure_skip_verify,
         )
     ca_material = None
@@ -287,7 +306,7 @@ def setup_tls(
         ca_pem=ca_pem,
         cert_pem=cert_pem,
         key_pem=key_pem,
-        client_auth=cfg.client_auth,
+        client_auth=client_auth,
         insecure_skip_verify=cfg.insecure_skip_verify,
     )
 

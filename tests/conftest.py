@@ -33,6 +33,11 @@ import pytest  # noqa: E402
 
 from gubernator_tpu.core import clock as clock_mod  # noqa: E402
 
+# raceguard: runtime lock-order + event-loop-stall detection, armed for
+# the whole session (GUBGUARD_RACE=0 disarms).  The static counterpart
+# is tools/gubguard; see docs/invariants.md.
+pytest_plugins = ["gubernator_tpu.testing.raceguard"]
+
 
 @pytest.fixture
 def frozen_clock():

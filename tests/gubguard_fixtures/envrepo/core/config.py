@@ -1,0 +1,9 @@
+"""env-parity fixture: a mini config parse site (never imported)."""
+import os
+
+
+def setup():
+    return {
+        "grpc": os.environ.get("GUBER_GRPC_ADDRESS", "localhost:1051"),
+        "cache": os.environ.get("GUBER_CACHE_SIZE", "50000"),
+    }

@@ -1,0 +1,16 @@
+"""Seeded blocking-in-async violations (never imported; parsed only)."""
+import time
+
+import grpc
+
+
+async def handler(path):
+    time.sleep(0.5)                       # line 8: flagged
+    data = open(path).read()              # line 9: flagged
+    chan = grpc.insecure_channel("x:1")   # line 10: flagged
+
+    def executor_job():
+        # Sync def nested in the async def: runs off-loop, NOT flagged.
+        return open(path).read()
+
+    return data, chan, executor_job

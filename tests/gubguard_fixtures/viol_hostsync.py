@@ -1,0 +1,16 @@
+"""Seeded host-sync violations (never imported; parsed by gubguard).
+
+This file stands in for a NON-executor module (its path matches no
+executor suffix), so every synchronizing call below must be flagged.
+"""
+import jax
+import numpy as np
+
+
+def serve(dev_array, resp):
+    host = np.asarray(dev_array)          # line 11: flagged
+    copied = jax.device_get(resp)         # line 12: flagged
+    resp.block_until_ready()              # line 13: flagged
+    first = float(dev_array[0])           # line 14: flagged
+    ok = np.asarray(resp)  # gubguard: ok — line 15: suppressed
+    return host, copied, first, ok

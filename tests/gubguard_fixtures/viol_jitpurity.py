@@ -1,0 +1,19 @@
+"""Seeded jit-purity violations (never imported; parsed only)."""
+import time
+
+import jax
+
+
+def _helper(now_arr):
+    # Reachable from the jit root through the same-module call graph.
+    return float(now_arr)                 # line 9: flagged (concretize)
+
+
+def impure_step(table, hits):
+    now = time.time()                     # line 13: flagged (wall clock)
+    if hits:                              # line 14: flagged (tracer branch)
+        return table
+    return _helper(now)
+
+
+step = jax.jit(impure_step)

@@ -1,0 +1,14 @@
+"""Seeded lock-order inversion (never imported; parsed only)."""
+
+
+def path_one(backend, engine):
+    # Declared order: backend before engine (parallel/global_sync.py).
+    with backend._lock, engine._lock:
+        pass
+
+
+def path_two(backend, engine):
+    # INVERTED: engine before backend — the deadlock pair.
+    with engine._lock:
+        with backend._lock:
+            pass

@@ -192,3 +192,29 @@ def test_sketch_tier_env_rejects_zero_window(monkeypatch):
     monkeypatch.setenv("GUBER_SKETCH_WINDOW", "500us")
     with _pytest.raises(ValueError, match="GUBER_SKETCH_WINDOW"):
         setup_daemon_config()
+
+
+def test_tls_client_auth_env_aliases_and_validation(monkeypatch):
+    from gubernator_tpu.core.config import (
+        normalize_tls_client_auth,
+        setup_daemon_config,
+    )
+
+    # Reference spellings (config.go:351-354) canonicalize.
+    assert normalize_tls_client_auth("request-cert") == "request"
+    assert normalize_tls_client_auth("verify-cert") == "verify-if-given"
+    assert normalize_tls_client_auth("require-any-cert") == "require-any"
+    # Canonical + legacy spellings pass through; case-insensitive.
+    assert normalize_tls_client_auth("Require") == "require"
+    assert normalize_tls_client_auth("") == ""
+
+    monkeypatch.setenv("GUBER_TLS_CERT", "/tmp/server.pem")
+    monkeypatch.setenv("GUBER_TLS_CLIENT_AUTH", "require-any-cert")
+    conf = setup_daemon_config()
+    assert conf.tls is not None
+    assert conf.tls.client_auth == "require-any"
+
+    # A typo'd mode must fail loudly, never silently disable client auth.
+    monkeypatch.setenv("GUBER_TLS_CLIENT_AUTH", "requre")
+    with pytest.raises(ValueError, match="client-auth"):
+        setup_daemon_config()

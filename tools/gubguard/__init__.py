@@ -1,0 +1,58 @@
+"""gubguard: project-specific static analysis for gubernator-tpu.
+
+Enforces the fast-lane invariants (docs/invariants.md) that the code
+otherwise carries only as convention: host-fetch containment, a
+non-blocking event loop, one global lock order, jit purity, and GUBER_*
+env parity.  Run as:
+
+    python -m tools.gubguard gubernator_tpu/
+
+Exit status 0 = clean (warnings allowed), 1 = errors (or warnings under
+--strict).  The runtime counterpart is the raceguard pytest plugin
+(gubernator_tpu/testing/raceguard.py).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.gubguard.blocking import BlockingChecker
+from tools.gubguard.core import Checker, Finding, run_checkers
+from tools.gubguard.envparity import EnvParityChecker
+from tools.gubguard.hostsync import HostSyncChecker
+from tools.gubguard.jitpurity import JitPurityChecker
+from tools.gubguard.lockorder import LockOrderChecker
+
+ALL_CHECKERS = (
+    "host-sync",
+    "async-blocking",
+    "lock-order",
+    "jit-purity",
+    "env-parity",
+)
+
+
+def make_checkers(select: Optional[Sequence[str]] = None) -> List[Checker]:
+    factory = {
+        "host-sync": HostSyncChecker,
+        "async-blocking": BlockingChecker,
+        "lock-order": LockOrderChecker,
+        "jit-purity": JitPurityChecker,
+        "env-parity": EnvParityChecker,
+    }
+    names = list(select) if select else list(ALL_CHECKERS)
+    unknown = [n for n in names if n not in factory]
+    if unknown:
+        raise ValueError(f"unknown checkers: {unknown}")
+    return [factory[n]() for n in names]
+
+
+def run(
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    root: Optional[Path] = None,
+) -> List[Finding]:
+    """Run the selected checkers over `paths`; returns sorted findings."""
+    return run_checkers(
+        [Path(p) for p in paths], make_checkers(select), root=root
+    )

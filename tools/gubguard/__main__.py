@@ -1,0 +1,67 @@
+"""CLI: python -m tools.gubguard [paths...] [--select a,b] [--strict]."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.gubguard import ALL_CHECKERS, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gubguard",
+        description=(
+            "Static analysis for gubernator-tpu's fast-lane invariants "
+            "(see docs/invariants.md)."
+        ),
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=["gubernator_tpu/"],
+        help="files or directories to scan (default: gubernator_tpu/)",
+    )
+    ap.add_argument(
+        "--select", metavar="NAMES",
+        help="comma-separated checker subset of: " + ", ".join(ALL_CHECKERS),
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="treat warnings as errors",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="repo root for docs/deploy scanning (default: cwd)",
+    )
+    args = ap.parse_args(argv)
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    findings = run(args.paths, select=select, root=Path(args.root))
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    errors = [
+        f for f in findings
+        if f.severity == "error" or (args.strict and f.severity == "warning")
+    ]
+    warnings = [f for f in findings if f.severity == "warning"]
+    if not args.as_json:
+        print(
+            f"gubguard: {len(errors)} error(s), "
+            f"{len(warnings)} warning(s)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

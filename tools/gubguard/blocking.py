@@ -1,0 +1,141 @@
+"""async-blocking: no blocking calls inside `async def` bodies.
+
+The daemon serves every RPC on one event loop; a single blocking call
+stalls every in-flight request and the batcher windows (the raceguard
+runtime plugin measures these stalls — this checker catches them before
+they run).  Flags, lexically inside an `async def` (but not inside a
+nested sync `def`, which is usually an executor callback):
+
+  time.sleep(...)                  use asyncio.sleep
+  open(...) / Path.read_text(...)  use a thread (loop.run_in_executor)
+  sync gRPC channels/servers       use grpc.aio
+  subprocess.run/call/check_*      use asyncio.create_subprocess_*
+  socket.getaddrinfo & friends     use loop.getaddrinfo / loop.run_in_executor
+  requests.* / urllib urlopen      use aiohttp
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.gubguard.core import Checker, Finding, ModuleInfo, dotted_name
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "use 'await asyncio.sleep(...)'",
+    "grpc.insecure_channel": "use 'grpc.aio.insecure_channel'",
+    "grpc.secure_channel": "use 'grpc.aio.secure_channel'",
+    "grpc.server": "use 'grpc.aio.server'",
+    "subprocess.run": "use 'asyncio.create_subprocess_exec'",
+    "subprocess.call": "use 'asyncio.create_subprocess_exec'",
+    "subprocess.check_call": "use 'asyncio.create_subprocess_exec'",
+    "subprocess.check_output": "use 'asyncio.create_subprocess_exec'",
+    "socket.getaddrinfo": "use 'loop.getaddrinfo'",
+    "socket.gethostbyname": "use 'loop.getaddrinfo'",
+    "socket.create_connection": "use 'asyncio.open_connection'",
+    "urllib.request.urlopen": "use aiohttp",
+    "os.system": "use 'asyncio.create_subprocess_shell'",
+}
+_BLOCKING_NAMES = {
+    "open": "wrap file I/O in 'loop.run_in_executor' (or read at init)",
+    "input": "never block the loop on stdin",
+}
+_BLOCKING_METHODS = {
+    "read_text": "pathlib file I/O blocks; run it in an executor",
+    "read_bytes": "pathlib file I/O blocks; run it in an executor",
+    "write_text": "pathlib file I/O blocks; run it in an executor",
+    "write_bytes": "pathlib file I/O blocks; run it in an executor",
+}
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, checker: "BlockingChecker", mod: ModuleInfo) -> None:
+        self.checker = checker
+        self.mod = mod
+        self.findings: List[Finding] = []
+        self._async_depth = 0
+        # Names bound by `from time import sleep`-style imports.
+        self._time_sleep_aliases = set()
+        self._requests_aliases = set()
+
+    # -- scope tracking --------------------------------------------------
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    self._time_sleep_aliases.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "requests":
+                self._requests_aliases.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._async_depth += 1
+        for child in node.body:
+            self.visit(child)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A sync def nested in an async def runs elsewhere (executor
+        # callback, functools helper) — not on the loop.
+        saved = self._async_depth
+        self._async_depth = 0
+        for child in node.body:
+            self.visit(child)
+        self._async_depth = saved
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self._async_depth
+        self._async_depth = 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    # -- the check -------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._async_depth > 0:
+            msg = self._classify(node)
+            if msg:
+                self.findings.append(Finding(
+                    checker=self.checker.name, path=self.mod.relpath,
+                    line=node.lineno, message=msg,
+                ))
+        self.generic_visit(node)
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        dn = dotted_name(fn)
+        if dn:
+            hint = _BLOCKING_DOTTED.get(dn)
+            if hint:
+                return f"blocking '{dn}' in async def: {hint}"
+            root = dn.split(".", 1)[0]
+            if root in self._requests_aliases and "." in dn:
+                return (
+                    f"blocking '{dn}' (sync HTTP) in async def: "
+                    "use aiohttp"
+                )
+        if isinstance(fn, ast.Name):
+            if fn.id in self._time_sleep_aliases:
+                return (
+                    "blocking 'time.sleep' in async def: use "
+                    "'await asyncio.sleep(...)'"
+                )
+            hint = _BLOCKING_NAMES.get(fn.id)
+            if hint:
+                return f"blocking '{fn.id}(...)' in async def: {hint}"
+        if isinstance(fn, ast.Attribute):
+            hint = _BLOCKING_METHODS.get(fn.attr)
+            if hint:
+                return f"blocking '.{fn.attr}(...)' in async def: {hint}"
+        return None
+
+
+class BlockingChecker(Checker):
+    name = "async-blocking"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        v = _AsyncVisitor(self, mod)
+        v.visit(mod.tree)
+        return v.findings

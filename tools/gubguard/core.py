@@ -1,0 +1,156 @@
+"""gubguard core: finding model, pragma handling, module loading, runner.
+
+The checkers enforce the fast-lane invariants that are otherwise only
+convention (docs/invariants.md):
+
+  host-sync       device->host fetches only inside the executor module set
+  async-blocking  no blocking calls on the event loop
+  lock-order      one global lock acquisition order
+  jit-purity      no wall-clock reads / tracer leaks in jitted code
+  env-parity      GUBER_* env surface matches docs + the reference set
+
+A finding is suppressed by a pragma comment on the flagged line or the
+line directly above it:
+
+    x = np.asarray(dev)  # gubguard: ok
+    # gubguard: ok=host-sync,jit-purity
+    y = float(arr[0])
+
+`ok` alone silences every checker for that line; `ok=<names>` silences
+only the named checkers.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+_PRAGMA_RE = re.compile(r"#\s*gubguard:\s*ok(?:=(?P<names>[\w,\-]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    severity: str = "error"  # "error" | "warning"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.checker}] "
+            f"{self.severity}: {self.message}"
+        )
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed python module handed to every checker."""
+
+    path: Path
+    relpath: str  # posix, relative to the scan root
+    source: str
+    tree: ast.Module
+    # line -> set of checker names suppressed there ("*" = all)
+    pragmas: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def suppressed(self, line: int, checker: str) -> bool:
+        for ln in (line, line - 1):
+            names = self.pragmas.get(ln)
+            if names and ("*" in names or checker in names):
+                return True
+        return False
+
+
+def _collect_pragmas(source: str) -> Dict[int, Set[str]]:
+    pragmas: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            names = m.group("names")
+            pragmas[tok.start[0]] = (
+                set(n.strip() for n in names.split(",") if n.strip())
+                if names else {"*"}
+            )
+    except tokenize.TokenError:
+        pass
+    return pragmas
+
+
+def load_module(path: Path, root: Path) -> Optional[ModuleInfo]:
+    try:
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return None
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    return ModuleInfo(
+        path=path, relpath=rel, source=source, tree=tree,
+        pragmas=_collect_pragmas(source),
+    )
+
+
+def iter_py_files(paths: Sequence[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` attribute/name chain as a string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Checker:
+    """Base checker.  `check_module` runs per file; `finalize` runs once
+    after every file has been visited (cross-module checks)."""
+
+    name = "base"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        return ()
+
+
+def run_checkers(
+    paths: Sequence[Path],
+    checkers: Sequence[Checker],
+    root: Optional[Path] = None,
+) -> List[Finding]:
+    root = root or Path.cwd()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        mod = load_module(path, root)
+        if mod is None:
+            continue
+        for ch in checkers:
+            for f in ch.check_module(mod):
+                if not mod.suppressed(f.line, ch.name):
+                    findings.append(f)
+    for ch in checkers:
+        findings.extend(ch.finalize(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings

@@ -1,0 +1,277 @@
+"""jit-purity: no wall-clock reads or tracer leaks in jitted code.
+
+Every function handed to `jax.jit` / `shard_map` is traced ONCE and
+replayed; a wall-clock read inside it freezes the trace-time value into
+the compiled executable (the bucket-expiry arithmetic then silently uses
+a stale `now` forever), and a Python branch on a tracer either throws a
+ConcretizationTypeError at runtime or — worse — bakes one branch in.
+The kernels take `now` as an argument for exactly this reason
+(ops/step.py); this checker keeps it that way.
+
+Flags, in any function reachable from a jit/shard_map entry point via
+same-module calls:
+
+  time.time / time.time_ns / time.monotonic / time.perf_counter
+  datetime.now / datetime.utcnow / Clock reads (.now(),
+  .millisecond_now(), time.time_ns via core.clock)
+  float()/int()/bool() casts and `.item()` reads of function parameters
+  `if`/`while` tests on bare (non-static) parameters
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.gubguard.core import Checker, Finding, ModuleInfo, dotted_name
+
+_IMPURE_DOTTED = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_CLOCK_METHODS = {"now", "millisecond_now", "utcnow"}
+
+
+def _jit_targets(tree: ast.Module) -> Set[str]:
+    """Names of module functions passed to jax.jit / shard_map (call or
+    decorator form, directly or through functools.partial)."""
+    targets: Set[str] = set()
+
+    def is_jit_callable(fn: ast.AST) -> bool:
+        dn = dotted_name(fn)
+        if dn is None:
+            return False
+        last = dn.split(".")[-1]
+        return last in ("jit", "shard_map", "_shard_map", "pallas_call")
+
+    def first_name_arg(call: ast.Call) -> Optional[str]:
+        for a in call.args:
+            dn = dotted_name(a)
+            if dn is not None:
+                return dn.split(".")[-1]
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and is_jit_callable(node.func):
+            nm = first_name_arg(node)
+            if nm:
+                targets.add(nm)
+        elif isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "functools.partial", "partial"
+        ):
+            if node.args and is_jit_callable(node.args[0]):
+                nm = None
+                for a in node.args[1:]:
+                    dn = dotted_name(a)
+                    if dn is not None:
+                        nm = dn.split(".")[-1]
+                        break
+                if nm:
+                    targets.add(nm)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                fn = dec.func if isinstance(dec, ast.Call) else dec
+                if is_jit_callable(fn):
+                    targets.add(node.name)
+                elif isinstance(dec, ast.Call) and dotted_name(fn) in (
+                    "functools.partial", "partial"
+                ):
+                    if dec.args and is_jit_callable(dec.args[0]):
+                        targets.add(node.name)
+    return targets
+
+
+def _static_argnames(tree: ast.Module) -> Set[str]:
+    """Every name listed in any static_argnames/static_argnums kwarg —
+    branches on those params are legitimate trace-time Python."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "static_argnames":
+                continue
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return names
+
+
+def _time_aliases(tree: ast.Module):
+    """(module aliases of time/datetime, names bound by `from time
+    import time`-style imports)."""
+    mod_alias = {}
+    fn_alias = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("time", "datetime"):
+                    mod_alias[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("time", "datetime"):
+                for a in node.names:
+                    if a.name in (
+                        "time", "time_ns", "monotonic", "perf_counter",
+                        "now", "utcnow", "datetime",
+                    ):
+                        if a.name == "datetime":
+                            mod_alias[a.asname or a.name] = "datetime"
+                        else:
+                            fn_alias.add(a.asname or a.name)
+    return mod_alias, fn_alias
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        tree = mod.tree
+        targets = _jit_targets(tree)
+        if not targets:
+            return ()
+        static_names = _static_argnames(tree)
+        self._mod_alias, self._fn_alias = _time_aliases(tree)
+
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+            elif isinstance(node, ast.Assign):
+                # `impl = lambda ...` / `fn = other_fn` aliases
+                if (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Lambda)
+                ):
+                    defs.setdefault(node.targets[0].id, node.value)
+
+        # BFS the same-module call graph from the jit roots.
+        reachable: Set[str] = set()
+        frontier = [t for t in targets if t in defs]
+        while frontier:
+            nm = frontier.pop()
+            if nm in reachable:
+                continue
+            reachable.add(nm)
+            for node in ast.walk(defs[nm]):
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func)
+                    if dn and "." not in dn and dn in defs:
+                        frontier.append(dn)
+
+        out: List[Finding] = []
+        for nm in sorted(reachable):
+            out.extend(self._check_fn(mod, nm, defs[nm], static_names))
+        return out
+
+    def _check_fn(
+        self, mod: ModuleInfo, nm: str, fn: ast.AST, static: Set[str]
+    ) -> Iterable[Finding]:
+        params: Set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            a = fn.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            ):
+                params.add(arg.arg)
+        tracer_params = params - static
+        out: List[Finding] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                msg = self._impure_call(node, tracer_params)
+                if msg:
+                    out.append(Finding(
+                        checker=self.name, path=mod.relpath,
+                        line=node.lineno,
+                        message=f"in jit-reachable '{nm}': {msg}",
+                    ))
+            elif isinstance(node, (ast.If, ast.While)):
+                leak = self._tracer_branch(node.test, tracer_params)
+                if leak:
+                    out.append(Finding(
+                        checker=self.name, path=mod.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"in jit-reachable '{nm}': python branch on "
+                            f"parameter '{leak}' — a tracer under jit; "
+                            "use jnp.where / lax.cond (or declare it in "
+                            "static_argnames)"
+                        ),
+                    ))
+        return out
+
+    def _impure_call(self, node: ast.Call, tracer_params: Set[str]) -> str:
+        dn = dotted_name(node.func)
+        if dn is not None and "." in dn:
+            # Resolve `import time as t` aliases to the real module.
+            root, rest = dn.split(".", 1)
+            real = self._mod_alias.get(root)
+            if real is not None:
+                dn = f"{real}.{rest}"
+        if dn in _IMPURE_DOTTED:
+            return (
+                f"wall-clock read '{dn}' freezes into the trace; pass "
+                "`now` as an argument (ops/step.py discipline)"
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in self._fn_alias
+        ):
+            return (
+                f"wall-clock read '{node.func.id}()' (imported from "
+                "time/datetime) freezes into the trace; pass `now` as "
+                "an argument"
+            )
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _CLOCK_METHODS and not node.args:
+                base = dotted_name(node.func.value) or ""
+                if "clock" in base.lower() or base.split(".")[-1] in (
+                    "datetime",
+                ):
+                    return (
+                        f"clock read '{base}.{node.func.attr}()' freezes "
+                        "into the trace; pass `now` as an argument"
+                    )
+            if node.func.attr == "item":
+                base = dotted_name(node.func.value)
+                if base in tracer_params:
+                    return (
+                        f"'.item()' on parameter '{base}' concretizes a "
+                        "tracer (host sync + trace break)"
+                    )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+        ):
+            base = dotted_name(node.args[0])
+            if base in tracer_params:
+                return (
+                    f"'{node.func.id}({base})' concretizes a tracer "
+                    "parameter"
+                )
+        return ""
+
+    @staticmethod
+    def _tracer_branch(
+        test: ast.AST, tracer_params: Set[str]
+    ) -> Optional[str]:
+        # Only bare `if param:` / `if param <op> const:` forms — richer
+        # expressions (shape reads, `is None` checks) are trace-time.
+        if isinstance(test, ast.Name) and test.id in tracer_params:
+            return test.id
+        if isinstance(test, ast.Compare):
+            for cmp_op in test.ops:
+                if isinstance(cmp_op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)):
+                    return None
+            sides = [test.left] + list(test.comparators)
+            names = [s.id for s in sides if isinstance(s, ast.Name)]
+            consts = [s for s in sides if isinstance(s, ast.Constant)]
+            if len(sides) == 2 and len(consts) == 1:
+                for nm in names:
+                    if nm in tracer_params:
+                        return nm
+        return None

@@ -1,0 +1,232 @@
+"""lock-order: one global lock acquisition order, machine-checked.
+
+The discipline documented at parallel/global_sync.py ("Lock order
+everywhere: auth (backend) before cache (self)") generalizes to a single
+global ranking; any two code paths that nest the same pair of locks in
+opposite orders can deadlock under concurrency (the classic inversion a
+race detector exists to catch).
+
+The checker extracts every lexically nested acquisition site —
+`with a._lock, b._lock:` items and `with` statements nested inside other
+`with` statements, sync or async — canonicalizes each lock expression to
+a lock CLASS, then verifies:
+
+  1. no pair of lock classes is acquired in both orders anywhere;
+  2. the merged acquisition graph is acyclic;
+  3. edges between RANKED locks respect the declared global order:
+       backend._keymap_lock < backend._lock < engine._lock
+                            < sketch._lock  < store._lock
+  4. no nested re-acquisition of the same (non-reentrant) lock class.
+
+Canonicalization: `self._lock` resolves through the enclosing class
+(DeviceBackend/MeshBackend -> backend._lock, GlobalEngine ->
+engine._lock, ...); `self.b._lock` / `backend._lock` resolve through the
+receiver variable name.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.gubguard.core import Checker, Finding, ModuleInfo, dotted_name
+
+# (enclosing class, attribute) -> canonical lock class
+CLASS_LOCK_MAP = {
+    ("PersistenceHost", "_lock"): "backend._lock",
+    ("DeviceBackend", "_lock"): "backend._lock",
+    ("MeshBackend", "_lock"): "backend._lock",
+    ("PersistenceHost", "_keymap_lock"): "backend._keymap_lock",
+    ("DeviceBackend", "_keymap_lock"): "backend._keymap_lock",
+    ("MeshBackend", "_keymap_lock"): "backend._keymap_lock",
+    ("GlobalEngine", "_lock"): "engine._lock",
+    ("SketchBackend", "_lock"): "sketch._lock",
+    ("Store", "_lock"): "store._lock",
+    ("MockStore", "_lock"): "store._lock",
+}
+# receiver variable name -> canonical prefix
+VAR_ALIAS = {
+    "b": "backend",
+    "backend": "backend",
+    "be": "backend",
+    "engine": "engine",
+    "eng": "engine",
+    "sketch": "sketch",
+    "sb": "sketch",
+    "store": "store",
+}
+# Declared global acquisition order (lower rank acquired first).
+RANK = {
+    "backend._keymap_lock": 10,
+    "backend._lock": 20,
+    "engine._lock": 30,
+    "sketch._lock": 40,
+    "store._lock": 50,
+}
+
+Site = Tuple[str, int]  # (relpath, line)
+
+
+def _is_lockish(attr: str) -> bool:
+    return attr == "lock" or attr.endswith("_lock") or attr.endswith("lock_")
+
+
+class _LockVisitor(ast.NodeVisitor):
+    def __init__(self, checker: "LockOrderChecker", mod: ModuleInfo) -> None:
+        self.checker = checker
+        self.mod = mod
+        self.class_stack: List[str] = []
+        self.held: List[Tuple[str, int]] = []  # (canonical, line)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A new function body starts with no lexically held locks (a
+        # callee acquiring under a caller's lock is runtime raceguard's
+        # job, not a lexical fact).
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _canonical(self, expr: ast.AST) -> Optional[str]:
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        attr = parts[-1]
+        if not _is_lockish(attr):
+            return None
+        recv = parts[:-1]
+        if recv == ["self"] or not recv:
+            cls = self.class_stack[-1] if self.class_stack else "<module>"
+            return CLASS_LOCK_MAP.get((cls, attr), f"{cls}.{attr}")
+        base = recv[-1] if recv[-1] != "self" else (
+            recv[-2] if len(recv) > 1 else "self"
+        )
+        if recv[0] == "self" and len(recv) > 1:
+            base = recv[1]
+        prefix = VAR_ALIAS.get(base, base)
+        return CLASS_LOCK_MAP.get((prefix, attr), f"{prefix}.{attr}")
+
+    def _visit_with(self, node) -> None:
+        acquired: List[Tuple[str, int]] = []
+        for item in node.items:
+            canon = self._canonical(item.context_expr)
+            if canon is None:
+                continue
+            if self.mod.suppressed(node.lineno, self.checker.name):
+                continue
+            site: Site = (self.mod.relpath, node.lineno)
+            for held, _hl in self.held + acquired:
+                self.checker.record_edge(held, canon, site)
+            acquired.append((canon, node.lineno))
+        self.held.extend(acquired)
+        for child in node.body:
+            self.visit(child)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+
+    def __init__(self) -> None:
+        # (held, acquired) -> first observed site
+        self.edges: Dict[Tuple[str, str], Site] = {}
+
+    def record_edge(self, held: str, acquired: str, site: Site) -> None:
+        self.edges.setdefault((held, acquired), site)
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        _LockVisitor(self, mod).visit(mod.tree)
+        return ()
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for (a, b), (path, line) in sorted(self.edges.items()):
+            if a == b:
+                out.append(Finding(
+                    checker=self.name, path=path, line=line,
+                    message=(
+                        f"nested re-acquisition of '{a}' — "
+                        "deadlock on a non-reentrant lock"
+                    ),
+                ))
+                continue
+            if (b, a) in self.edges:
+                op, ol = self.edges[(b, a)]
+                out.append(Finding(
+                    checker=self.name, path=path, line=line,
+                    message=(
+                        f"lock-order inversion: '{a}' -> '{b}' here but "
+                        f"'{b}' -> '{a}' at {op}:{ol}"
+                    ),
+                ))
+            ra, rb = RANK.get(a), RANK.get(b)
+            if ra is not None and rb is not None and ra > rb:
+                out.append(Finding(
+                    checker=self.name, path=path, line=line,
+                    message=(
+                        f"'{a}' acquired before '{b}' violates the "
+                        "declared global order (see docs/invariants.md): "
+                        + " < ".join(sorted(RANK, key=RANK.get))
+                    ),
+                ))
+        out.extend(self._cycles())
+        return out
+
+    def _cycles(self) -> List[Finding]:
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            if a != b and (b, a) not in self.edges:
+                graph.setdefault(a, []).append(b)
+        # Iterative DFS cycle detection (2-cycles already reported above).
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        out: List[Finding] = []
+
+        def dfs(start: str) -> Optional[List[str]]:
+            stack: List[Tuple[str, Iterable[str]]] = [
+                (start, iter(graph.get(start, ())))
+            ]
+            path = [start]
+            color[start] = GREY
+            while stack:
+                node, it = stack[-1]
+                nxt = next(it, None)
+                if nxt is None:
+                    color[node] = BLACK
+                    stack.pop()
+                    path.pop()
+                    continue
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    return path[path.index(nxt):] + [nxt]
+                if c == WHITE:
+                    color[nxt] = GREY
+                    path.append(nxt)
+                    stack.append((nxt, iter(graph.get(nxt, ()))))
+            return None
+
+        for n in list(graph):
+            if color.get(n, WHITE) == WHITE:
+                cyc = dfs(n)
+                if cyc:
+                    site = self.edges.get((cyc[0], cyc[1]), ("<graph>", 0))
+                    out.append(Finding(
+                        checker=self.name, path=site[0], line=site[1],
+                        message=(
+                            "lock acquisition cycle: "
+                            + " -> ".join(cyc)
+                        ),
+                    ))
+                    break
+        return out
