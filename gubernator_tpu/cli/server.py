@@ -42,6 +42,20 @@ def main() -> None:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
+
+        def dump_flightrec() -> None:
+            # SIGUSR2: operator-initiated flight-recorder dump (the Go
+            # expvar/pprof-on-signal idiom).  Fire-and-forget on the loop;
+            # a disarmed recorder just logs where to turn it on.
+            if daemon.flightrec is None:
+                logging.getLogger("gubernator_tpu").warning(
+                    "SIGUSR2: flight recorder disabled "
+                    "(set GUBER_FLIGHTREC=1)"
+                )
+                return
+            asyncio.ensure_future(daemon.flightrec.dump("signal"))
+
+        loop.add_signal_handler(signal.SIGUSR2, dump_flightrec)
         await stop.wait()
         logging.getLogger("gubernator_tpu").info("shutting down")
         await daemon.close()

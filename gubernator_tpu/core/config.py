@@ -163,6 +163,14 @@ class DaemonConfig:
     gossip_bind_address: str = ""  # host:port UDP; default grpc_port+1000
     gossip_seeds: List[str] = field(default_factory=list)
     etcd_endpoints: str = "localhost:2379"
+    # Kubernetes discovery (reference kubernetes.go:36-110 /
+    # config.go:467-504): which Endpoints/Pods to watch and how to map
+    # them to peer addresses.  pod_ip marks ourselves in the peer list.
+    k8s_namespace: str = "default"
+    k8s_endpoints_selector: str = ""
+    k8s_pod_ip: str = ""
+    k8s_pod_port: int = 81
+    k8s_watch_mechanism: str = "endpoints"  # endpoints | pods
     log_level: str = "info"
     # TLS (reference tls.go / config.go:338-368)
     tls: Optional["TLSConfig"] = None
@@ -188,6 +196,18 @@ class DaemonConfig:
     # the limit and keep the strict depth-1 maximal-merge discipline).
     # 0 disables.
     fastpath_sparse: int = 64
+    # Flight recorder / SLO telemetry (runtime/flightrec.py).  Off by
+    # default: the ring + sampler are cheap, but dumps write to disk and
+    # operators should choose the directory.
+    flightrec: bool = False
+    flightrec_dir: str = "flightrec-dumps"
+    flightrec_ring: int = 512
+    # Rolling-p99 target in MILLISECONDS (BASELINE.json: p99 < 2ms); a
+    # trailing-window p99 over it increments slo_breach_total and dumps.
+    slo_p99_ms: float = 2.0
+    # > 0: on breach, also start a time-boxed jax.profiler trace of this
+    # many seconds under <flightrec_dir>/profile.
+    flightrec_profile_s: float = 0.0
 
 
 @dataclass
@@ -387,6 +407,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             if s.strip()
         ],
         etcd_endpoints=_env("GUBER_ETCD_ENDPOINTS", "localhost:2379"),
+        k8s_namespace=_env("GUBER_K8S_NAMESPACE", "default"),
+        k8s_endpoints_selector=_env("GUBER_K8S_ENDPOINTS_SELECTOR", ""),
+        k8s_pod_ip=_env("GUBER_K8S_POD_IP", ""),
+        k8s_pod_port=_env_int("GUBER_K8S_POD_PORT", 81),
+        k8s_watch_mechanism=_env("GUBER_K8S_WATCH_MECHANISM", "endpoints"),
         log_level=_env("GUBER_LOG_LEVEL", "info"),
         tls=tls,
         sketch=sketch,
@@ -398,6 +423,14 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env_int("GUBER_FASTPATH_INFLIGHT", 1), 1,
         ),
         fastpath_sparse=fastpath_sparse_from_env(),
+        flightrec=_env("GUBER_FLIGHTREC") in ("1", "true"),
+        flightrec_dir=_env("GUBER_FLIGHTREC_DIR", "flightrec-dumps"),
+        flightrec_ring=_require_min(
+            "GUBER_FLIGHTREC_RING",
+            _env_int("GUBER_FLIGHTREC_RING", 512), 1,
+        ),
+        slo_p99_ms=float(_env("GUBER_SLO_P99_MS", "2.0")),
+        flightrec_profile_s=_env_float_s("GUBER_FLIGHTREC_PROFILE", 0.0),
     )
 
 

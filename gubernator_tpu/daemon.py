@@ -64,12 +64,16 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
                 failed = "true"
                 raise
             finally:
+                dur = time.monotonic() - start
                 m.grpc_request_counts.labels(
                     method=method, failed=failed
                 ).inc()
-                m.grpc_request_duration.labels(method=method).observe(
-                    time.monotonic() - start
-                )
+                m.grpc_request_duration.labels(method=method).observe(dur)
+                fr = m.flightrec
+                if fr is not None:
+                    # Every RPC feeds the rolling SLO window (the p99 the
+                    # north star is stated against is request latency).
+                    fr.observe_request(dur)
 
         return grpc.unary_unary_rpc_method_handler(
             wrapped,
@@ -167,6 +171,12 @@ class Daemon:
         self.conf = conf or DaemonConfig()
         self.clock = clock
         self.metrics = Metrics()
+        # Flight recorder (runtime/flightrec.py): armed per config; the
+        # Metrics bundle carries it to the layers that feed it.
+        from gubernator_tpu.runtime.flightrec import recorder_from_config
+
+        self.flightrec = recorder_from_config(self.conf, self.metrics)
+        self.metrics.flightrec = self.flightrec
         # AutoTLS certs must carry the advertise host in their SANs or
         # cross-host peer dials fail hostname verification.
         adv_host = (
@@ -223,6 +233,8 @@ class Daemon:
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
         )
+        if self.flightrec is not None:
+            self.flightrec.start()
         self.service = Service(
             cfg,
             clock=self.clock,
@@ -349,6 +361,8 @@ class Daemon:
             self.fastpath = None
         if self.service is not None:
             await self.service.close()
+        if self.flightrec is not None:
+            await self.flightrec.close()
 
     # -- HTTP gateway (daemon.go:231-270) --------------------------------
     async def _start_http(self) -> None:
@@ -356,6 +370,8 @@ class Daemon:
         app.router.add_post("/v1/GetRateLimits", self._http_get_rate_limits)
         app.router.add_get("/v1/HealthCheck", self._http_health)
         app.router.add_get("/metrics", self._http_metrics)
+        app.router.add_get("/debug/flightrec", self._http_flightrec)
+        app.router.add_get("/debug/vars", self._http_vars)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         host, _, port = self.conf.http_listen_address.rpartition(":")
@@ -430,11 +446,82 @@ class Daemon:
                 self.metrics.global_cache_occupancy.set(
                     self.service.global_engine.cache_occupancy()
                 )
+            # Per-peer rolling error windows (the HealthCheck signal,
+            # peer_client.last_errors) as scrape-time gauges.
+            for peer in (
+                self.service.peer_list()
+                + self.service.region_picker.peers()
+            ):
+                self.metrics.peer_error_window.labels(
+                    peerAddr=peer.info().grpc_address
+                ).set(len(peer.last_errors()))
         return web.Response(
             body=self.metrics.render(),
             content_type="text/plain",
             charset="utf-8",
         )
+
+    # -- debug plane (runtime/flightrec.py) ------------------------------
+    async def _http_flightrec(self, request: web.Request):
+        """Live flight-recorder snapshot; `?limit=N` caps the ring tail."""
+        if self.flightrec is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "set GUBER_FLIGHTREC=1 to arm the recorder"},
+                status=404,
+            )
+        try:
+            limit = int(request.query.get("limit", "0")) or None
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        snap = self.flightrec.snapshot(limit=limit)
+        snap["enabled"] = True
+        return web.json_response(snap)
+
+    async def _http_vars(self, request: web.Request):
+        """expvar-style internal counters (the Go daemon exposes
+        /debug/vars via expvar; these are the TPU engine's equivalents)."""
+        out = {
+            "grpc_address": self.grpc_address,
+            "http_address": self.http_address,
+        }
+        s = self.service
+        if s is not None:
+            be = s.backend
+            out["backend"] = {
+                "checks": be.checks,
+                "over_limit": be.over_limit,
+                "not_persisted": be.not_persisted,
+                "occupancy": be.occupancy(),
+            }
+            out["inflight_checks"] = s._inflight_checks
+            out["global"] = {
+                "async_sends": s.global_mgr.async_sends,
+                "broadcasts": s.global_mgr.broadcasts,
+                "reread_batches": s.global_mgr.reread_batches,
+                "reread_keys": s.global_mgr.reread_keys,
+            }
+            out["multi_region_sends"] = s.multi_region_mgr.region_sends
+            out["peers"] = {
+                p.info().grpc_address: len(p.last_errors())
+                for p in s.peer_list() + s.region_picker.peers()
+            }
+        fp = self.fastpath
+        if fp is not None:
+            out["fastpath"] = {
+                "fallbacks": getattr(fp, "fallbacks", 0),
+            }
+        fr = self.flightrec
+        if fr is not None:
+            out["flightrec"] = {
+                "breaches": fr.breaches,
+                "dumps": fr.dumps,
+                "last_p50_ms": round(fr.last_p50_ms, 3),
+                "last_p99_ms": round(fr.last_p99_ms, 3),
+                "loop_lag_ms_max": round(fr.max_lag_ms, 2),
+                "last_dump_path": fr.last_dump_path,
+            }
+        return web.json_response(out)
 
     # -- peers / discovery ----------------------------------------------
     def advertise_address(self) -> str:
@@ -525,7 +612,15 @@ class Daemon:
         elif kind == "k8s":
             from gubernator_tpu.discovery.k8s import K8sPool
 
-            self._pool = K8sPool(on_update)
+            self._pool = K8sPool(
+                on_update,
+                namespace=self.conf.k8s_namespace,
+                selector=self.conf.k8s_endpoints_selector,
+                pod_ip=self.conf.k8s_pod_ip,
+                pod_port=self.conf.k8s_pod_port,
+                mechanism=self.conf.k8s_watch_mechanism,
+                http_port=int(self.http_address.rpartition(":")[2]),
+            )
         elif kind == "etcd":
             from gubernator_tpu.discovery.etcd import EtcdPool
 

@@ -374,6 +374,17 @@ class PeerClient:
 
     def _record_error(self, msg: str) -> None:
         self._errors.append((time.monotonic(), msg))
+        if self.metrics is not None:
+            self.metrics.peer_error_total.labels(
+                peerAddr=self.peer_info.grpc_address
+            ).inc()
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record(
+                    "peer_error",
+                    peer=self.peer_info.grpc_address,
+                    error=msg[:200],
+                )
 
     def _track_inflight(self, delta: int) -> None:
         self._inflight += delta
@@ -440,9 +451,17 @@ class PeerClient:
         try:
             resps = await self._call_get_peer_rate_limits(reqs)
             if self.metrics is not None:
+                send_s = time.monotonic() - start
                 self.metrics.batch_send_duration.labels(
                     peerAddr=self.peer_info.grpc_address
-                ).observe(time.monotonic() - start)
+                ).observe(send_s)
+                fr = getattr(self.metrics, "flightrec", None)
+                if fr is not None:
+                    fr.record_batch(
+                        len(batch), send_s * 1e3,
+                        peer=self.peer_info.grpc_address,
+                        kind="peer_batch_send",
+                    )
             if len(resps) != len(batch):
                 msg = "peer returned %d responses for %d requests" % (
                     len(resps), len(batch)

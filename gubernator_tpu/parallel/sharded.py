@@ -309,8 +309,11 @@ class MeshBackend(PersistenceHost):
                         self._keymap[key_hash64(k)] = k
             self._maybe_prune_keymap()
 
+        import time as time_mod
+
         round_resps = []
         captured = None
+        t_start = time_mod.monotonic()
         with self._lock:
             if self.store is not None:
                 self._seed_from_store(reqs, packed, now_ms)
@@ -330,11 +333,21 @@ class MeshBackend(PersistenceHost):
                 )
                 wt_seq = self._wt_ticket()
         try:
+            step_s = time_mod.monotonic() - t_start
+            if self.metrics is not None:
+                self.metrics.device_step_duration.observe(step_s)
             out, tally = unmarshal_responses(
                 len(reqs), packed.errors, packed.positions,
                 packed_grid_rounds_to_host(round_resps),
             )
             self._add_tally(tally)
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record_batch(
+                    len(reqs), step_s * 1e3,
+                    over_limit=tally.over_limit,
+                    errors=len(packed.errors),
+                )
         finally:
             # Redeem the ticket even if unmarshal fails (see
             # DeviceBackend.check) — unredeemed tickets wedge delivery.

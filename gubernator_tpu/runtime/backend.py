@@ -405,10 +405,9 @@ class DeviceBackend(PersistenceHost):
                 )
                 wt_seq = self._wt_ticket()
         try:
+            step_s = time.monotonic() - t_start
             if self.metrics is not None:
-                self.metrics.device_step_duration.observe(
-                    time.monotonic() - t_start
-                )
+                self.metrics.device_step_duration.observe(step_s)
                 self.metrics.pool_queue_length.observe(len(reqs))
             # One packed sync per round (one transfer instead of six).
             out, tally = unmarshal_responses(
@@ -416,6 +415,13 @@ class DeviceBackend(PersistenceHost):
                 packed_rounds_to_host(round_resps),
             )
             self._add_tally(tally)
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record_batch(
+                    len(reqs), step_s * 1e3,
+                    over_limit=tally.over_limit,
+                    errors=len(packed.errors),
+                )
         finally:
             # The ticket MUST be redeemed even if unmarshal fails, or
             # every later delivery wedges in cond.wait (the step itself
@@ -437,11 +443,19 @@ class DeviceBackend(PersistenceHost):
         with add_tally, tallies update vectorized (the fast lane passes
         False and counts per REQUEST — cascade occurrences share device
         lanes)."""
+        t_start = time.monotonic()
         with self._lock:
             round_resps = self._dispatch_rounds_locked(rounds)
         host = packed_rounds_to_host(round_resps)
         if add_tally:
-            self._add_tally(tally_from_rounds(rounds, host))
+            tally = tally_from_rounds(rounds, host)
+            self._add_tally(tally)
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record_batch(
+                    tally.checks, (time.monotonic() - t_start) * 1e3,
+                    over_limit=tally.over_limit,
+                )
         return host
 
     def _dispatch_rounds_locked(self, rounds) -> list:

@@ -58,6 +58,7 @@ the semantic reference:
 from __future__ import annotations
 
 import asyncio
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -371,9 +372,7 @@ class FastPath:
                     "'PeerRequest.rate_limits' list too large; max size "
                     "is '%d'" % MAX_BATCH_SIZE,
                 )
-            self.s.metrics.check_error_counter.labels(
-                error="Request too large"
-            ).inc()
+            self.s.metrics.note_check_error("Request too large")
             raise ApiError(
                 "OUT_OF_RANGE",
                 "Requests.RateLimits list too large; max size is '%d'"
@@ -384,9 +383,7 @@ class FastPath:
             # rejections (gubernator.go:229, 235).
             n_inv = int(((cols.err == 1) | (cols.err == 2)).sum())
             if n_inv:
-                self.s.metrics.check_error_counter.labels(
-                    error="Invalid request"
-                ).inc(n_inv)
+                self.s.metrics.note_check_error("Invalid request", n_inv)
         sk: Optional[np.ndarray] = None
         if self.s.sketch_backend is not None and n:
             sk = np.isin(cols.name_hash, self._sketch_hashes()) & (
@@ -1580,6 +1577,7 @@ class FastPath:
                 foundv[sel] = hr["found"][idx]
                 persv[sel] = hr["persisted"][idx]
 
+        t_step0 = time.monotonic()
         if plan is None and not do_store:
             # Plain merge: dispatch under the backend lock, sync outside
             # — arrivals keep accumulating into the NEXT maximal merge
@@ -1714,12 +1712,19 @@ class FastPath:
         # hit/miss + eviction tallies from the device rounds.
         valid = h != 0
         t = tally_from_rounds(rounds, host)
+        n_over = int((status[valid] == 1).sum())
         backend._add_tally(Tally(
             checks=int(valid.sum()),
-            over_limit=int((status[valid] == 1).sum()),
+            over_limit=n_over,
             not_persisted=t.not_persisted,
             cache_hits=t.cache_hits,
         ))
+        fr = getattr(self.s.metrics, "flightrec", None)
+        if fr is not None:
+            fr.record_batch(
+                int(valid.sum()), (time.monotonic() - t_step0) * 1e3,
+                over_limit=n_over, kind="fastlane_drain",
+            )
 
         sb = self.s.sketch_backend
         if sb is not None and sb.spill_enabled:

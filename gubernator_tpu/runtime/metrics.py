@@ -6,18 +6,65 @@ gauges for the device engine (slot occupancy, device step latency).
 All collectors live on a private registry (like the daemon's private
 prometheus registry, daemon.go:85-99) so multiple daemons can share one
 process in tests — the in-process cluster fixture depends on this.
+
+DIVERGENCE from the reference: every hot-path timing is a **Histogram**,
+not a Summary.  The Go client's Summary exports quantiles; the python
+client's exports only _count/_sum, which made the p99 < 2ms SLO
+(BASELINE.json) unobservable in production — the whole point of the LX
+telemetry plane.  Buckets are shared (`LATENCY_BUCKETS`) and tuned for
+the µs→ms serving regime with an exact boundary at the 2ms SLO target;
+`estimate_quantile` turns a scrape's cumulative bucket counts back into
+a latency estimate (the PromQL histogram_quantile interpolation).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 from prometheus_client import (
     CollectorRegistry,
     Counter,
     Gauge,
+    Histogram,
     Summary,
     generate_latest,
 )
+
+# Shared latency buckets (seconds), 50µs .. 2.5s.  2e-3 is a bucket
+# boundary on purpose: the north-star SLO is p99 < 2ms, so breach
+# accounting from a scrape never interpolates across the target.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    50e-6, 100e-6, 250e-6, 500e-6,
+    1e-3, 2e-3, 4e-3, 8e-3, 16e-3, 32e-3, 64e-3,
+    0.128, 0.256, 0.512, 1.024, 2.5,
+)
+
+
+def estimate_quantile(
+    buckets: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """Latency estimate for quantile `q` from CUMULATIVE histogram bucket
+    counts — the client-side analog of PromQL's histogram_quantile():
+    find the bucket where the target rank lands, then interpolate
+    linearly inside it.  `buckets` are the upper bounds (no +Inf entry);
+    `counts[i]` is the cumulative count <= buckets[i], and an extra
+    final entry (the +Inf count) is allowed.  Returns the upper bound of
+    the last finite bucket when the rank lands in +Inf."""
+    if not counts:
+        return 0.0
+    total = counts[-1]
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_bound = 0.0
+    prev_count = 0
+    for i, bound in enumerate(buckets):
+        c = counts[i]
+        if rank <= c:
+            span = c - prev_count
+            frac = 1.0 if span <= 0 else (rank - prev_count) / span
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_count = bound, c
+    return float(buckets[-1])
 
 
 class Metrics:
@@ -26,6 +73,10 @@ class Metrics:
     def __init__(self, registry: Optional[CollectorRegistry] = None) -> None:
         self.registry = registry or CollectorRegistry()
         r = self.registry
+        # Flight recorder hook (runtime/flightrec.py): when a daemon arms
+        # one, the layers already holding this bundle (backend, peers,
+        # interceptor) feed it without new plumbing.
+        self.flightrec = None
 
         # -- request path (gubernator.go:59-113) -------------------------
         self.check_counter = Counter(
@@ -55,10 +106,11 @@ class Metrics:
             "Concurrent rate checks in flight.",
             registry=r,
         )
-        self.func_duration = Summary(
+        self.func_duration = Histogram(
             "gubernator_func_duration",
             "Timings of key functions in seconds.",
             ["name"],
+            buckets=LATENCY_BUCKETS,
             registry=r,
         )
         self.asyncrequest_retries = Counter(
@@ -69,10 +121,11 @@ class Metrics:
         )
 
         # -- batching / peer traffic (peer_client, workers) ---------------
-        self.batch_send_duration = Summary(
+        self.batch_send_duration = Histogram(
             "gubernator_batch_send_duration",
             "Timings of batch sends to a remote peer.",
             ["peerAddr"],
+            buckets=LATENCY_BUCKETS,
             registry=r,
         )
         self.queue_length = Summary(
@@ -87,16 +140,31 @@ class Metrics:
             "analog).",
             registry=r,
         )
-
-        # -- GLOBAL replication (global.go:48-57) -------------------------
-        self.async_durations = Summary(
-            "gubernator_async_durations",
-            "Timings of GLOBAL async sends in seconds.",
+        self.peer_error_window = Gauge(
+            "gubernator_peer_error_window",
+            "Errors in a peer's rolling health window (refreshed at "
+            "scrape from PeerClient.last_errors).",
+            ["peerAddr"],
             registry=r,
         )
-        self.broadcast_durations = Summary(
+        self.peer_error_total = Counter(
+            "gubernator_peer_error_total",
+            "Errors recorded against a peer since daemon start.",
+            ["peerAddr"],
+            registry=r,
+        )
+
+        # -- GLOBAL replication (global.go:48-57) -------------------------
+        self.async_durations = Histogram(
+            "gubernator_async_durations",
+            "Timings of GLOBAL async sends in seconds.",
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.broadcast_durations = Histogram(
             "gubernator_broadcast_durations",
             "Timings of GLOBAL broadcasts to peers in seconds.",
+            buckets=LATENCY_BUCKETS,
             registry=r,
         )
 
@@ -131,17 +199,51 @@ class Metrics:
             ["method", "failed"],
             registry=r,
         )
-        self.grpc_request_duration = Summary(
+        self.grpc_request_duration = Histogram(
             "gubernator_grpc_request_duration",
             "Timings of gRPC requests in seconds.",
             ["method"],
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+
+        # -- SLO / flight recorder (runtime/flightrec.py) -----------------
+        self.slo_p50 = Gauge(
+            "gubernator_slo_p50_seconds",
+            "Rolling p50 of gRPC request latency over the flight "
+            "recorder's trailing window.",
+            registry=r,
+        )
+        self.slo_p99 = Gauge(
+            "gubernator_slo_p99_seconds",
+            "Rolling p99 of gRPC request latency over the flight "
+            "recorder's trailing window.",
+            registry=r,
+        )
+        self.slo_breach_total = Counter(
+            "gubernator_slo_breach_total",
+            "Evaluation windows whose rolling p99 exceeded the "
+            "GUBER_SLO_P99_MS target.",
+            registry=r,
+        )
+        self.loop_lag = Gauge(
+            "gubernator_event_loop_lag_seconds",
+            "Latest event-loop lag sample (scheduling delay of the "
+            "flight recorder's periodic tick).",
+            registry=r,
+        )
+        self.flightrec_dump_total = Counter(
+            "gubernator_flightrec_dump_total",
+            "Flight-recorder snapshots dumped to disk, by trigger.",
+            ["reason"],  # slo_breach | error_storm | signal | http
             registry=r,
         )
 
         # -- TPU-specific -------------------------------------------------
-        self.device_step_duration = Summary(
+        self.device_step_duration = Histogram(
             "gubernator_tpu_device_step_duration",
             "Wall time of one jitted device batch step in seconds.",
+            buckets=LATENCY_BUCKETS,
             registry=r,
         )
         self.device_occupancy = Gauge(
@@ -155,6 +257,15 @@ class Metrics:
             "(mesh GlobalEngine; sized by global_cache_slots).",
             registry=r,
         )
+
+    def note_check_error(self, error: str, n: int = 1) -> None:
+        """Count a check error AND feed the flight recorder's
+        error-storm window — the one call every rejection path uses so
+        storm detection can't drift from the counter."""
+        self.check_error_counter.labels(error=error).inc(n)
+        fr = self.flightrec
+        if fr is not None:
+            fr.note_error(n)
 
     def render(self) -> bytes:
         """Text exposition for the /metrics endpoint."""

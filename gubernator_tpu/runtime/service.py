@@ -121,6 +121,11 @@ class Service:
             )
         self._inflight_checks = 0
         self._peer_credentials = peer_credentials
+        # Cached label child: the hot path must not pay a labels() dict
+        # lookup per call (reference funcTimeMetric, gubernator.go:118).
+        self._fd_get_rate_limits = self.metrics.func_duration.labels(
+            "V1Instance.GetRateLimits"
+        )
 
         def picker_hash(name: str, which: str):
             # Named error over a bare KeyError (config.go:403-425
@@ -320,9 +325,7 @@ class Service:
     ) -> List[RateLimitResp]:
         """The hot path (gubernator.go:194-310)."""
         if len(reqs) > MAX_BATCH_SIZE:
-            self.metrics.check_error_counter.labels(
-                error="Request too large"
-            ).inc()
+            self.metrics.note_check_error("Request too large")
             raise ApiError(
                 "OUT_OF_RANGE",
                 "Requests.RateLimits list too large; max size is '%d'"
@@ -330,6 +333,7 @@ class Service:
             )
         self._inflight_checks += 1
         self.metrics.concurrent_checks.observe(self._inflight_checks)
+        start = time.monotonic()
         try:
             with tracing.span(
                 "V1Instance.GetRateLimits", num_items=len(reqs)
@@ -337,6 +341,7 @@ class Service:
                 return await self._get_rate_limits(reqs)
         finally:
             self._inflight_checks -= 1
+            self._fd_get_rate_limits.observe(time.monotonic() - start)
 
     async def _get_rate_limits(
         self, reqs: Sequence[RateLimitReq]
@@ -361,17 +366,13 @@ class Service:
             # or MULTI_REGION hits.  The peer RPC keeps the owner-side
             # packer validation with QueueUpdate-before-algorithm semantics.
             if not req.unique_key:
-                self.metrics.check_error_counter.labels(
-                    error="Invalid request"
-                ).inc()
+                self.metrics.note_check_error("Invalid request")
                 responses[i] = RateLimitResp(
                     error="field 'unique_key' cannot be empty"
                 )
                 continue
             if not req.name:
-                self.metrics.check_error_counter.labels(
-                    error="Invalid request"
-                ).inc()
+                self.metrics.note_check_error("Invalid request")
                 responses[i] = RateLimitResp(
                     error="field 'namespace' cannot be empty"
                 )
@@ -673,6 +674,18 @@ class Service:
         if errs:
             h.status = UNHEALTHY
             h.message = "|".join(errs)
+        # SLO telemetry rides along (runtime/flightrec.py): the rolling
+        # p99 vs the configured target, so degraded-mode decisions can
+        # key off measured tail latency (status itself stays driven by
+        # peer connectivity, like the reference).
+        fr = getattr(self.metrics, "flightrec", None)
+        if fr is not None and fr.breaches:
+            slo = (
+                f"SLO: {fr.breaches} p99 breach(es) of "
+                f"{fr.slo_p99_ms:g}ms target; rolling "
+                f"p99={fr.last_p99_ms:.3f}ms"
+            )
+            h.message = f"{h.message}|{slo}" if h.message else slo
         return h
 
     def _engine_synced(self, pending) -> None:

@@ -1,0 +1,98 @@
+"""CI smoke: boot one daemon with the flight recorder armed, drive a few
+checks, scrape /metrics and /debug/flightrec, assert the telemetry plane
+is actually there (histogram buckets, SLO series, ring records).
+
+Run from the repo root:  GUBER_FLIGHTREC=1 python scripts/flightrec_smoke.py
+Exits non-zero with a labeled assertion on any missing piece.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable from a checkout without an installed package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main() -> None:
+    from gubernator_tpu.core.config import (
+        DaemonConfig,
+        DeviceConfig,
+        setup_daemon_config,
+    )
+    from gubernator_tpu.core.types import RateLimitReq
+    from gubernator_tpu.daemon import Daemon
+    from gubernator_tpu.net.grpc_api import V1Stub, req_to_pb
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    env = setup_daemon_config()
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        device=DeviceConfig(num_slots=4096, ways=8, batch_size=128),
+        flightrec=True,
+        flightrec_dir=env.flightrec_dir,
+        slo_p99_ms=env.slo_p99_ms,
+    )
+    daemon = Daemon(conf)
+    await daemon.start()
+    try:
+        import grpc.aio
+
+        ch = grpc.aio.insecure_channel(daemon.grpc_address)
+        stub = V1Stub(ch)
+        req = pb.GetRateLimitsReq(requests=[
+            req_to_pb(RateLimitReq(
+                name="smoke", unique_key=f"k{i}", hits=1, limit=100,
+                duration=60_000,
+            ))
+            for i in range(8)
+        ])
+        for _ in range(5):
+            await stub.GetRateLimits(req)
+        await ch.close()
+        # One sampler tick so the SLO gauges refresh.
+        await asyncio.sleep(0.6)
+
+        def _get_sync(path: str) -> bytes:
+            url = f"http://{daemon.http_address}{path}"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.read()
+
+        loop = asyncio.get_running_loop()
+
+        async def get(path: str) -> bytes:
+            # The daemon serves on THIS loop — a sync urlopen here would
+            # deadlock against our own HTTP server.
+            return await loop.run_in_executor(None, _get_sync, path)
+
+        text = (await get("/metrics")).decode()
+        for needle in (
+            'gubernator_grpc_request_duration_bucket{le="0.002"',
+            "gubernator_tpu_device_step_duration_bucket",
+            "gubernator_slo_p99_seconds",
+            "gubernator_slo_breach_total",
+            "gubernator_event_loop_lag_seconds",
+        ):
+            assert needle in text, f"/metrics missing {needle!r}"
+
+        snap = json.loads(await get("/debug/flightrec"))
+        assert snap["enabled"] is True, snap
+        assert snap["rolling"]["samples"] >= 5, snap["rolling"]
+        kinds = {r["kind"] for r in snap["ring"]}
+        assert kinds, "flight-recorder ring is empty"
+
+        vars_ = json.loads(await get("/debug/vars"))
+        assert vars_["backend"]["checks"] >= 40, vars_["backend"]
+        assert "flightrec" in vars_, vars_
+        print("flightrec smoke OK:", sorted(kinds))
+    finally:
+        await daemon.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -43,6 +43,7 @@ CLASS_LOCK_MAP = {
     ("SketchBackend", "_lock"): "sketch._lock",
     ("Store", "_lock"): "store._lock",
     ("MockStore", "_lock"): "store._lock",
+    ("FlightRecorder", "_lock"): "flightrec._lock",
 }
 # receiver variable name -> canonical prefix
 VAR_ALIAS = {
@@ -54,14 +55,20 @@ VAR_ALIAS = {
     "sketch": "sketch",
     "sb": "sketch",
     "store": "store",
+    "flightrec": "flightrec",
+    "fr": "flightrec",
 }
 # Declared global acquisition order (lower rank acquired first).
+# flightrec._lock ranks LAST: any layer may record into the flight
+# recorder while holding its own lock (e.g. under backend._lock in a
+# drain), and the recorder never takes another lock while holding its own.
 RANK = {
     "backend._keymap_lock": 10,
     "backend._lock": 20,
     "engine._lock": 30,
     "sketch._lock": 40,
     "store._lock": 50,
+    "flightrec._lock": 60,
 }
 
 Site = Tuple[str, int]  # (relpath, line)
