@@ -164,6 +164,27 @@ async def run(args) -> None:
         stats = await timed(fn, args.seconds, conc)
         print(json.dumps({"scenario": name, **stats}))
 
+    if args.recompile_audit:
+        # Runtime counterpart of gubtrace's static recompile audit
+        # (tools/gubtrace): after the canonical workload above, report
+        # the live jit-cache entry count per registered module-level
+        # kernel.  Counts beyond the warmed tier/shape set mean
+        # recompiles landed inside the serving window — the storm the
+        # static audit exists to prevent.
+        try:
+            from tools.gubtrace.recompile import runtime_cache_report
+        except ImportError:
+            print(json.dumps({
+                "scenario": "recompile_audit",
+                "error": "tools.gubtrace not importable (run from a "
+                         "repo checkout)",
+            }))
+        else:
+            print(json.dumps({
+                "scenario": "recompile_audit",
+                "jit_caches": runtime_cache_report(),
+            }))
+
     await client.close()
     await ch.close()
     for d in daemons:
@@ -176,6 +197,12 @@ def main() -> None:
     p.add_argument("--concurrency", type=int, default=16)
     p.add_argument("--slots", type=int, default=65_536)
     p.add_argument("--batch", type=int, default=1024)
+    p.add_argument(
+        "--recompile-audit", action="store_true",
+        help="after the scenarios, report per-kernel jit cache "
+             "hits/misses via the gubtrace registry (runtime "
+             "counterpart of `python -m tools.gubtrace`)",
+    )
     asyncio.run(run(p.parse_args()))
 
 

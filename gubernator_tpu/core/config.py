@@ -318,6 +318,14 @@ def load_config_file(path: str) -> None:
             os.environ[k.strip()] = val.strip()
 
 
+def gubtrace_dump_dir_from_env() -> str:
+    """Where `python -m tools.gubtrace` writes failing kernels' jaxpr
+    dumps (CI uploads the directory as the failure artifact).  Parsed
+    here so the GUBTRACE_* env surface rides the same
+    config->example.conf->envparity discipline as GUBER_*."""
+    return _env("GUBTRACE_DUMP_DIR", "gubtrace-dumps")
+
+
 def fastpath_sparse_from_env() -> int:
     """The sparse-overlap drain knob, parsed/validated exactly as the
     daemon does — the public entry for harnesses (bench_e2e) that build

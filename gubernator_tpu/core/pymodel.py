@@ -33,8 +33,26 @@ from gubernator_tpu.core.types import (
 )
 
 
+_I64_MAX = 2**63 - 1
+_I64_MIN = -(2**63)
+
+
 def _trunc(x: float) -> int:
-    """Go's int64(float64) — truncation toward zero."""
+    """Go's int64(float64) — truncation toward zero — pinned to the
+    device kernel's exact edge semantics (ops/step.py _trunc_i64):
+    out-of-range values SATURATE at the int64 bounds and NaN maps to 0
+    (XLA convert behavior; Go itself is implementation-dependent here —
+    amd64 collapses all three cases to INT64_MIN).  A bare
+    int(math.trunc(x)) would diverge beyond ±2^63 (python ints are
+    unbounded) and raise on NaN/inf; the differential suite
+    (tests/test_differential.py::test_go_trunc_differential) holds the
+    two implementations bit-identical across the full edge matrix."""
+    if math.isnan(x):
+        return 0
+    if x >= _I64_MAX:
+        return _I64_MAX
+    if x <= _I64_MIN:
+        return _I64_MIN
     return int(math.trunc(x))
 
 

@@ -100,7 +100,16 @@ def _f64(x: jax.Array) -> jax.Array:
 
 
 def _trunc_i64(x: jax.Array) -> jax.Array:
-    """Go's int64(float64): truncation toward zero (XLA convert semantics)."""
+    """Go's int64(float64): truncation toward zero.
+
+    Edge semantics are XLA convert's, differentially pinned against the
+    oracle (core/pymodel.py _trunc; tests/test_differential.py::
+    test_go_trunc_differential): -1.5 -> -1 (toward zero, not floor),
+    exact through +/-2^62, out-of-range/inf SATURATE at the int64
+    bounds, NaN -> 0.  Go's own spec leaves these implementation-
+    dependent (amd64 CVTTSD2SI gives INT64_MIN for all three), so the
+    saturating behavior is this build's documented contract.
+    """
     return x.astype(jnp.int64)
 
 

@@ -33,6 +33,29 @@ from gubernator_tpu.core.config import SketchTierConfig
 from gubernator_tpu.core.types import RateLimitReq, RateLimitResp, Status
 
 
+def make_multi_step(impl):
+    """Jitted scan over k chunks: ONE dispatch per merge, chunks applied
+    in order on device (each sees the previous chunk's adds, the same
+    sequencing the per-chunk host loop had).  Returns
+    (state', packed int32[k, 2, B]) — over/est stacked so the whole
+    response is one transfer.  Module-level factory so the gubtrace
+    kernel registry (tools/gubtrace/registry.py) verifies the same
+    computation the backend dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    def multi(state, kh, hits, lim, now):
+        def body(st, xs):
+            khr, hr, lr = xs
+            st, over, est = impl(st, khr, hr, lr, now)
+            return st, jnp.stack([over.astype(jnp.int32), est])
+
+        st, packed = jax.lax.scan(body, state, (kh, hits, lim))
+        return st, packed
+
+    return jax.jit(multi, donate_argnums=(0,))
+
+
 class SketchBackend:
     """CMS limiter over fixed-shape device batches."""
 
@@ -263,23 +286,9 @@ class SketchBackend:
             fn = self._multi.get(k)
             if fn is not None:
                 return fn
-            import jax
-            import jax.numpy as jnp
-
             from gubernator_tpu.ops.sketch import init_sketch
 
-            impl = self._impl
-
-            def multi(state, kh, hits, lim, now):
-                def body(st, xs):
-                    khr, hr, lr = xs
-                    st, over, est = impl(st, khr, hr, lr, now)
-                    return st, jnp.stack([over.astype(jnp.int32), est])
-
-                st, packed = jax.lax.scan(body, state, (kh, hits, lim))
-                return st, packed
-
-            fn = jax.jit(multi, donate_argnums=(0,))
+            fn = make_multi_step(self._impl)
             warm_state = init_sketch(
                 depth=self.cfg.depth, width=self.cfg.width,
                 window_ms=self.cfg.window_ms,

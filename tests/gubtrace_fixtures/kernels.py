@@ -1,0 +1,140 @@
+"""Seeded-violation kernels: each gubtrace checker must catch its
+fixture (tests/test_gubtrace.py).  Imported by the test, registered via
+the `specs=` override of tools.gubtrace.run — never by the real
+registry.
+
+Every fixture enables ONLY the checker it seeds, so one violation
+can't bleed findings into another checker's assertion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from tools.gubtrace.core import BuiltKernel, KernelSpec
+
+_WHERE = "tests/gubtrace_fixtures/kernels.py"
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# -- dtype-taint: an int64 counter silently narrowed to int32 ------------
+def _bad_narrow_impl(counters, now):
+    jnp = _jnp()
+    # The seeded bug: value arithmetic in int32 — wraps at 2^31.
+    small = counters.astype(jnp.int32) + jnp.int32(1)
+    return small.astype(jnp.int64) + now
+
+
+# -- dtype-taint (float flavor): counter math demoted to float32 ---------
+def _bad_float_impl(counters, now):
+    jnp = _jnp()
+    frac = counters.astype(jnp.float32) * jnp.float32(0.5)
+    return frac.astype(jnp.int64) + now
+
+
+# -- host-escape: a debug print left inside the kernel -------------------
+def _bad_callback_impl(x):
+    import jax
+
+    jax.debug.print("remaining={r}", r=x[0])
+    return x + 1
+
+
+# -- donation: donated buffer that cannot alias any output ---------------
+def _bad_donation_impl(state, x):
+    jnp = _jnp()
+    # `state` (int64[64]) is donated but the only output is float32 of
+    # a different shape — XLA drops the donation with a warning.
+    return (x.astype(jnp.float32) * 2.0).reshape(8, 8)
+
+
+# -- primitive-budget: one more gather than the golden snapshot ----------
+def _bad_budget_impl(table, idx):
+    return table[idx] + table[idx + 1]  # two gathers; golden says one
+
+
+# -- recompile: weak-type `now` leaks into the cache key -----------------
+def _bad_recompile_impl(counters, now):
+    jnp = _jnp()
+    return counters + jnp.asarray(now, dtype=jnp.int64)
+
+
+def _spec(name, impl, sigs, invariant, *, counters=(), donate=None,
+          expect_aliased=0, perturbations=None, recompile_budget=None,
+          suppress=frozenset()):
+    def build() -> BuiltKernel:
+        import jax
+
+        fn = jax.jit(
+            impl,
+            donate_argnums=donate if donate is not None else (),
+        )
+        return BuiltKernel(
+            fn=fn,
+            trace_fn=impl,
+            signatures=sigs,
+            counters=counters,
+            allowed_casts={},
+            perturbations=perturbations or {},
+            recompile_budget=recompile_budget,
+            expect_aliased=expect_aliased,
+        )
+
+    return KernelSpec(
+        name=name, where=_WHERE, build=build,
+        invariants=frozenset({invariant}), suppress=suppress,
+    )
+
+
+def _i64(n=64):
+    return np.zeros(n, np.int64)
+
+
+FIXTURE_SPECS = [
+    _spec(
+        "viol_dtype_narrow", _bad_narrow_impl,
+        {"B64": lambda: (_i64(), np.int64(0))},
+        "dtype-taint", counters=("[0]", "[1]"),
+    ),
+    _spec(
+        "viol_dtype_float", _bad_float_impl,
+        {"B64": lambda: (_i64(), np.int64(0))},
+        "dtype-taint", counters=("[0]", "[1]"),
+    ),
+    _spec(
+        "viol_hostescape", _bad_callback_impl,
+        {"B64": lambda: (_i64(),)},
+        "host-escape",
+    ),
+    _spec(
+        "viol_donation", _bad_donation_impl,
+        {"B64": lambda: (_i64(), _i64())},
+        "donation", donate=(0,), expect_aliased=1,
+    ),
+    _spec(
+        "viol_budget", _bad_budget_impl,
+        {"B64": lambda: (_i64(256), np.zeros(64, np.int64))},
+        "primitive-budget",
+    ),
+    _spec(
+        "viol_recompile", _bad_recompile_impl,
+        {"B64": lambda: (_i64(), np.int64(0))},
+        "recompile",
+        perturbations={"weak-now": lambda: (_i64(), 0)},
+        # Deliberately under-declared: the weak-type perturbation adds
+        # a second cache entry the budget does not account for.
+        recompile_budget=1,
+    ),
+    # The same narrowed kernel with the checker suppressed — proves the
+    # spec-level pragma works (docs/gubtrace.md).
+    _spec(
+        "viol_dtype_suppressed", _bad_narrow_impl,
+        {"B64": lambda: (_i64(), np.int64(0))},
+        "dtype-taint", counters=("[0]", "[1]"),
+        suppress=frozenset({"dtype-taint"}),
+    ),
+]

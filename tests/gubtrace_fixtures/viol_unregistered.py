@@ -1,0 +1,12 @@
+"""registry-completeness fixture: a module-level jitted kernel that the
+registry does not know about (and one exempted by pragma).  Parsed by
+the checker as source, never imported."""
+import jax
+
+
+def _impl(x):
+    return x + 1
+
+
+sneaky_kernel = jax.jit(_impl)
+exempt_kernel = jax.jit(_impl)  # gubtrace: ok=registry

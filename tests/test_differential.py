@@ -233,3 +233,36 @@ def test_differential_global_engine_sync_interleavings(frozen_clock):
                 assert g.remaining == want.remaining, ctx
                 assert g.reset_time == want.reset_time, ctx
         frozen_clock.advance(rng.choice([0, 100, 2_000]))
+
+
+def test_go_trunc_differential():
+    """The `_go_trunc` contract (ops/step.py:102-113): the device
+    kernel's float64->int64 truncation and the oracle's `_trunc`
+    (core/pymodel.py) must agree bit-for-bit across the edge matrix —
+    negatives (toward zero, NOT floor), exact +/-2^62, the largest
+    float64 below 2^63, out-of-range saturation, infinities, and NaN.
+    A divergence here silently skews leaky-bucket remaining/rate."""
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gubernator_tpu.core.pymodel import _trunc
+    from gubernator_tpu.ops.step import _trunc_i64
+
+    f64_below_2_63 = math.nextafter(2.0**63, 0.0)  # 9223372036854774784
+    vals = [
+        0.0, -0.0, 0.5, -0.5, 1.9, -1.5, -2.7, 2.999,
+        2.0**62, -(2.0**62), 2.0**62 + 4096.0, -(2.0**62) - 4096.0,
+        f64_below_2_63, -f64_below_2_63,
+        2.0**63, -(2.0**63), 9.3e18, -9.3e18, 1e308, -1e308,
+        float("inf"), float("-inf"), float("nan"),
+        math.nextafter(1.0, 0.0), math.nextafter(-1.0, 0.0),
+    ]
+    kernel = np.asarray(_trunc_i64(jnp.asarray(vals, dtype=jnp.float64)))
+    for v, got in zip(vals, kernel):
+        want = _trunc(v)
+        assert int(got) == want, (
+            f"_go_trunc diverged at {v!r}: kernel {int(got)}, "
+            f"oracle {want}"
+        )

@@ -1,13 +1,16 @@
-"""env-parity: the GUBER_* env surface must match docs + the reference.
+"""env-parity: the GUBER_*/GUBTRACE_* env surface must match docs + the
+reference.
 
 Three-way diff between
 
-  parsed     -- GUBER_* string literals in the scanned python modules
-                (core/config.py is the canonical parse site);
-  referenced -- GUBER_* tokens in README.md, docs/ and deploy/ (what we
+  parsed     -- GUBER_*/GUBTRACE_* string literals in the scanned
+                python modules (core/config.py is the canonical parse
+                site — gubtrace's knobs route through it too);
+  referenced -- env tokens in README.md, docs/ and deploy/ (what we
                 promise operators);
   reference  -- the Go reference daemon's env surface (config.go), the
-                compatibility target.
+                compatibility target (GUBER_* only; GUBTRACE_* is this
+                build's tooling surface).
 
 Rules:
   * referenced-but-not-parsed is an ERROR: a manifest or doc promises a
@@ -28,7 +31,7 @@ from typing import Dict, Iterable, List, Set
 
 from tools.gubguard.core import Checker, Finding, ModuleInfo
 
-_VAR_RE = re.compile(r"\bGUBER_[A-Z0-9_]+\b")
+_VAR_RE = re.compile(r"\b(?:GUBER|GUBTRACE)_[A-Z0-9_]+\b")
 
 # The Go reference daemon's env surface (config.go:253-504).  Vars the
 # rebuild already parses are checked dynamically; this list exists so

@@ -1,0 +1,131 @@
+"""CLI: python -m tools.gubtrace [--select a,b] [--kernel name] [--update].
+
+Must configure the platform BEFORE jax initializes: the verifier runs
+device-free (JAX_PLATFORMS=cpu) on a virtual 8-device host platform so
+the mesh kernels trace exactly as CI's virtual pod slice does.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pin_cpu_platform() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def main(argv=None) -> int:
+    _pin_cpu_platform()
+    from pathlib import Path
+
+    from tools.gubtrace import ALL_CHECKERS, run
+
+    ap = argparse.ArgumentParser(
+        prog="gubtrace",
+        description=(
+            "jaxpr-level static verification of every registered "
+            "jitted kernel (see docs/gubtrace.md)."
+        ),
+    )
+    ap.add_argument(
+        "--select", metavar="NAMES",
+        help="comma-separated checker subset of: " + ", ".join(ALL_CHECKERS),
+    )
+    ap.add_argument(
+        "--kernel", action="append", metavar="NAME",
+        help="restrict to this registered kernel (repeatable)",
+    )
+    ap.add_argument(
+        "--update", action="store_true",
+        help="regenerate the golden primitive-count snapshots",
+    )
+    ap.add_argument(
+        "--list", action="store_true", dest="list_kernels",
+        help="list registered kernels and exit",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="treat warnings as errors",
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="repo root (default: cwd)",
+    )
+    ap.add_argument(
+        "--dump-dir", default=None,
+        help=(
+            "where to write failing kernels' jaxpr dumps "
+            "(default: $GUBTRACE_DUMP_DIR or gubtrace-dumps)"
+        ),
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_kernels:
+        from tools.gubtrace.registry import specs
+
+        for s in specs():
+            print(f"{s.name}  ({s.where})")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    ctx_out: list = []
+    findings = run(
+        select=select,
+        kernels=args.kernel,
+        root=Path(args.root),
+        update_golden=args.update,
+        ctx_out=ctx_out,
+    )
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    errors = [
+        f for f in findings
+        if f.severity == "error" or (args.strict and f.severity == "warning")
+    ]
+    warnings = [f for f in findings if f.severity == "warning"]
+
+    if errors and ctx_out:
+        # Jaxpr dumps for the failure artifact (CI uploads this dir).
+        from gubernator_tpu.core.config import gubtrace_dump_dir_from_env
+
+        dump_dir = Path(args.dump_dir or gubtrace_dump_dir_from_env())
+        dump_dir.mkdir(parents=True, exist_ok=True)
+        failing = {f.kernel for f in errors}
+        for kernel, sigs in ctx_out[0].jaxprs.items():
+            if kernel not in failing:
+                continue
+            for sig, jaxpr in sigs.items():
+                p = dump_dir / f"{kernel}.{sig}.jaxpr.txt"
+                p.write_text(str(jaxpr), encoding="utf-8")
+        if not args.as_json:
+            print(f"gubtrace: jaxpr dumps written to {dump_dir}/")
+
+    if not args.as_json:
+        n_k = len(ctx_out[0].jaxprs) if ctx_out else 0
+        print(
+            f"gubtrace: {n_k} kernel(s) verified, {len(errors)} "
+            f"error(s), {len(warnings)} warning(s)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,230 @@
+"""gubtrace core: kernel specs, jaxpr walking, finding model, runner.
+
+gubguard (tools/gubguard) checks what the Python *source* promises;
+gubtrace checks what XLA will actually *compile*.  Every registered
+jitted entrypoint (tools/gubtrace/registry.py) is traced with
+`jax.make_jaxpr` over a canonical shape/dtype matrix — no accelerator
+needed, the whole suite runs under `JAX_PLATFORMS=cpu` — and the closed
+jaxprs are walked to enforce the device-side invariants:
+
+  dtype-taint       no silent counter/timestamp dtype escapes
+  host-escape       no callback primitives inside hot-path kernels
+  donation          declared donate_argnums survive into the lowering
+  primitive-budget  golden per-kernel counts of expensive primitives
+  recompile         jit cache misses match the declared budget
+  registry          every module-level jitted kernel is registered
+
+A kernel opts out of a checker via its spec's `suppress` set, or — for
+the registry-completeness checker — a `# gubtrace: ok[=registry]`
+pragma on the module-level `foo = jax.jit(...)` assignment line.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+_PRAGMA_RE = re.compile(r"#\s*gubtrace:\s*ok(?:=(?P<names>[\w,\-]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str
+    kernel: str  # registered kernel name ("-" for cross-kernel findings)
+    message: str
+    severity: str = "error"  # "error" | "warning"
+    where: str = ""  # source location hint (file:line when known)
+
+    def render(self) -> str:
+        loc = f" ({self.where})" if self.where else ""
+        return (
+            f"{self.kernel}: [{self.checker}] {self.severity}: "
+            f"{self.message}{loc}"
+        )
+
+
+@dataclass
+class BuiltKernel:
+    """A kernel instantiated over its canonical signature matrix.
+
+    `fn` is the *jitted* entrypoint (donation/recompile probe it);
+    `trace_fn` is what make_jaxpr traces (usually the un-jitted impl).
+    `signatures` maps signature name -> a zero-arg builder returning a
+    fresh concrete args tuple — a builder, not a tuple, because the
+    recompile audit executes kernels whose donated buffers die on
+    first use.  Every built tuple must be safe to execute on CPU at
+    the canonical shapes.
+    """
+
+    fn: Callable
+    trace_fn: Callable
+    signatures: Dict[str, Callable[[], tuple]]
+    # Pytree-path substrings marking int64 counter/timestamp inputs
+    # whose dataflow the dtype checker taints (matched against the
+    # flattened keypath string, e.g. "[0].remaining" or "[2]").
+    counters: Tuple[str, ...] = ()
+    # Declared tainted-cast budget: {"to_f64": n, "to_f32": n,
+    # "to_i32": n, ...}.  Any tainted convert_element_type beyond the
+    # declared multiset is an error (see checkers/dtype.py).
+    allowed_casts: Dict[str, int] = field(default_factory=dict)
+    # Recompile audit: perturbed variants (name -> zero-arg args
+    # builder, e.g. python-scalar `now`) and the declared total
+    # jit-cache-entry budget after replaying every signature twice +
+    # every variant.
+    perturbations: Dict[str, Callable[[], tuple]] = field(
+        default_factory=dict
+    )
+    recompile_budget: Optional[int] = None
+    # Donation: expected aliased input leaves (None = every donated
+    # leaf must alias; 0 = kernel declares no donation).
+    expect_aliased: Optional[int] = None
+
+
+@dataclass
+class KernelSpec:
+    name: str
+    where: str  # repo-relative source module of the kernel
+    build: Callable[[], BuiltKernel]
+    invariants: frozenset = frozenset(
+        {"dtype-taint", "host-escape", "donation", "primitive-budget",
+         "recompile"}
+    )
+    suppress: frozenset = frozenset()
+
+    def checks(self) -> frozenset:
+        return self.invariants - self.suppress
+
+
+# -- jaxpr walking --------------------------------------------------------
+
+def subjaxprs(eqn) -> List[Any]:
+    """Every sub-jaxpr (closed or open) of one equation, any primitive."""
+    out: List[Any] = []
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if hasattr(x, "eqns"):  # open Jaxpr
+                out.append(x)
+            elif hasattr(x, "jaxpr") and getattr(x, "jaxpr", None) is not None:
+                out.append(x.jaxpr)  # ClosedJaxpr
+    return out
+
+
+def iter_eqns(jaxpr) -> Iterable[Any]:
+    """All equations of a (possibly closed) jaxpr, recursively."""
+    j = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in j.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def eqn_source(eqn) -> str:
+    """Best-effort user file:line for an equation (repo frames first)."""
+    try:
+        frames = list(eqn.source_info.traceback.frames)
+    except Exception:
+        return ""
+    best = ""
+    for fr in frames:
+        fname = getattr(fr, "file_name", "")
+        line = getattr(fr, "line_num", 0) or getattr(fr, "start_line", 0)
+        if "gubernator_tpu" in fname or "gubtrace_fixtures" in fname:
+            return f"{fname.rsplit('/repo/', 1)[-1]}:{line}"
+        if not best and "site-packages" not in fname:
+            best = f"{fname}:{line}"
+    return best
+
+
+def taint_mask(args: tuple, counters: Sequence[str]) -> List[bool]:
+    """Per-flattened-leaf taint mask for `args`, aligned with the invars
+    of make_jaxpr over the same args (both use tree_flatten order)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(args)
+    mask = []
+    for path, _leaf in flat:
+        key = jax.tree_util.keystr(path)
+        mask.append(any(pat in key for pat in counters))
+    return mask
+
+
+class Checker:
+    """Base jaxpr checker: `check` runs per kernel."""
+
+    name = "base"
+
+    def check(self, spec: KernelSpec, built: BuiltKernel,
+              ctx: "RunContext") -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, ctx: "RunContext") -> Iterable[Finding]:
+        return ()
+
+
+@dataclass
+class RunContext:
+    """Shared state for one gubtrace run."""
+
+    root: Any  # Path to the repo root
+    golden_dir: Any  # Path to the golden snapshot dir
+    update_golden: bool = False
+    # kernel name -> {sig name -> closed jaxpr} (filled by the runner,
+    # consumed by checkers and the CLI's failure dumps)
+    jaxprs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    # names of kernels that failed to build (skipped with a warning)
+    skipped: List[str] = field(default_factory=list)
+
+
+def trace_kernel(built: BuiltKernel) -> Dict[str, Any]:
+    """make_jaxpr over every canonical signature."""
+    import jax
+
+    out = {}
+    for sig_name, make_args in built.signatures.items():
+        out[sig_name] = jax.make_jaxpr(built.trace_fn)(*make_args())
+    return out
+
+
+def run_kernels(
+    specs: Sequence[KernelSpec],
+    checkers: Sequence[Checker],
+    ctx: RunContext,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for spec in specs:
+        try:
+            built = spec.build()
+            ctx.jaxprs[spec.name] = trace_kernel(built)
+        except Exception as e:  # environment gap (e.g. missing dep)
+            ctx.skipped.append(spec.name)
+            findings.append(Finding(
+                checker="trace", kernel=spec.name, severity="error",
+                message=f"failed to build/trace: {type(e).__name__}: {e}",
+            ))
+            continue
+        enabled = spec.checks()
+        for ch in checkers:
+            if ch.name not in enabled:
+                continue
+            try:
+                findings.extend(ch.check(spec, built, ctx))
+            except Exception as e:  # one kernel's quirk, not the run's
+                findings.append(Finding(
+                    checker=ch.name, kernel=spec.name,
+                    message=f"checker crashed: {type(e).__name__}: {e}",
+                ))
+    for ch in checkers:
+        findings.extend(ch.finalize(ctx))
+    findings.sort(key=lambda f: (f.kernel, f.checker, f.message))
+    return findings

@@ -1,0 +1,102 @@
+"""donation: every declared donate_argnums buffer survives to the HLO.
+
+`donate_argnums` is how the 10M-key state table avoids being copied on
+every step — a dropped donation silently doubles the table's HBM
+traffic and footprint.  XLA *warns* (once, easily lost in logs) and
+carries on.  This checker fails instead: it lowers each kernel at its
+first canonical signature and requires that the number of aliased
+input buffers matches the number of donated leaves.
+
+Two lowering shapes exist:
+  * single-device jits record aliasing as per-parameter
+    `tf.aliasing_output` attrs in the StableHLO;
+  * SPMD (shard_map) lowerings only materialize aliasing at compile
+    time, as the compiled module's `input_output_alias={...}` table —
+    so when the StableHLO shows none we compile (CPU, small shapes)
+    and parse that.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+from tools.gubtrace.core import (
+    BuiltKernel,
+    Checker,
+    Finding,
+    KernelSpec,
+    RunContext,
+)
+
+# One `{out_idx}: (param, {shape_idx}, may-alias)` entry per aliased
+# buffer in the compiled module's input_output_alias table.
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\(\d+,[^)]*-alias\)")
+
+
+def _compiled_alias_count(compiled_text: str) -> int:
+    if "input_output_alias=" not in compiled_text:
+        return 0
+    return len(_ALIAS_ENTRY_RE.findall(compiled_text))
+
+
+class DonationChecker(Checker):
+    name = "donation"
+
+    def check(self, spec: KernelSpec, built: BuiltKernel,
+              ctx: RunContext) -> Iterable[Finding]:
+        import jax
+
+        sig_name, make_args = next(iter(built.signatures.items()))
+        try:
+            lowered = built.fn.lower(*make_args())
+        except Exception as e:
+            return [Finding(
+                checker=self.name, kernel=spec.name, severity="warning",
+                message=f"could not lower for donation check: {e}",
+            )]
+        donated = sum(
+            1 for a in jax.tree_util.tree_leaves(lowered.args_info)
+            if a.donated
+        )
+        expected = built.expect_aliased
+        if expected is None:
+            expected = donated
+        out: List[Finding] = []
+        if donated == 0 and expected:
+            return [Finding(
+                checker=self.name, kernel=spec.name,
+                message=(
+                    f"[{sig_name}] expected {expected} donated leaves "
+                    "but the lowering donates none — donate_argnums "
+                    "was dropped"
+                ),
+            )]
+        if expected == 0:
+            return ()
+        aliased = lowered.as_text().count("tf.aliasing_output")
+        if aliased < expected:
+            # SPMD lowerings record aliasing only post-compile.
+            try:
+                aliased = _compiled_alias_count(
+                    lowered.compile().as_text()
+                )
+            except Exception as e:
+                return [Finding(
+                    checker=self.name, kernel=spec.name,
+                    severity="warning",
+                    message=(
+                        f"could not compile for donation check: {e}"
+                    ),
+                )]
+        if aliased < expected:
+            out.append(Finding(
+                checker=self.name, kernel=spec.name,
+                message=(
+                    f"[{sig_name}] {donated} input leaves are donated "
+                    f"but only {aliased}/{expected} alias an output in "
+                    "the lowered computation — the donation is "
+                    "silently dropped (double HBM traffic on this "
+                    "buffer)"
+                ),
+            ))
+        return out

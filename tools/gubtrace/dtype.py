@@ -1,0 +1,270 @@
+"""dtype-taint: no silent counter/timestamp dtype escapes.
+
+The failure class ("When Two is Worse Than One", PAPERS.md — silent
+accounting divergence): a refactor introduces an int64→float or
+int64→int32 `convert_element_type` on counter dataflow, XLA compiles it
+without complaint, and remaining/expiry arithmetic silently loses
+precision (f32 is exact only to 2^24; i32 wraps at 2^31 — both far
+below real token budgets and unix-ms timestamps).
+
+Mechanics: each kernel declares its int64 counter/timestamp inputs
+(`BuiltKernel.counters`, pytree-path patterns).  Taint starts on those
+invars and propagates through every equation along the int64/uint64
+lineage — once a value is *deliberately* converted (the leaky bucket's
+Go-float arithmetic), the cast is charged against the kernel's declared
+`allowed_casts` budget and the float lineage is not re-flagged.  Any
+tainted cast beyond the declared multiset is an error naming the
+offending source line.
+
+Casts are bucketed by destination:
+  to_f64  — deliberate Go-semantics float math (budgeted per kernel)
+  to_f32 / to_f16 — precision loss for counters (budget only when the
+            kernel's contract bounds the value, e.g. CMS cells)
+  to_i32 / narrower — wrap/truncation (budget only for fields whose
+            contract bounds them, e.g. packed algo enums)
+Casts to bool (lane predicates) and within the 64-bit integer family
+are free — they cannot corrupt a counter.  Also free: *index* casts,
+i64→i32 whose every (transitive, through shape-only ops) consumer is
+the index operand of a gather/scatter/dynamic-slice — jnp indexing
+narrows indices to i32 as a matter of course and slot/bucket spaces
+are bounded by table geometry (num_slots << 2^31), not by counter
+values.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.gubtrace.core import (
+    BuiltKernel,
+    Checker,
+    Finding,
+    KernelSpec,
+    RunContext,
+    eqn_source,
+    taint_mask,
+)
+
+_WIDE_INT = ("int64", "uint64")
+
+
+def _bucket(dtype_name: str) -> str:
+    if dtype_name.startswith("float64"):
+        return "to_f64"
+    if dtype_name.startswith(("float32",)):
+        return "to_f32"
+    if dtype_name.startswith(("float16", "bfloat16")):
+        return "to_f16"
+    if dtype_name.startswith(("int32", "uint32")):
+        return "to_i32"
+    if dtype_name.startswith(("int16", "uint16", "int8", "uint8")):
+        return "to_i8"
+    return ""
+
+
+# Ops that only reshape/relocate an index lineage without using values.
+_SHAPE_ONLY = frozenset({
+    "broadcast_in_dim", "reshape", "concatenate", "slice", "squeeze",
+    "expand_dims", "transpose", "rev", "copy",
+})
+
+
+def _index_positions(eqn) -> List[int]:
+    """invars positions that are *index* operands of this primitive."""
+    name = eqn.primitive.name
+    n = len(eqn.invars)
+    if name == "gather":
+        return [1]
+    if name in ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                "scatter-max"):
+        return [1]
+    if name == "dynamic_slice":
+        return list(range(1, n))
+    if name == "dynamic_update_slice":
+        return list(range(2, n))
+    return []
+
+
+def _is_index_only(var, eqn_of_var, consumers, outvar_ids) -> bool:
+    """True when every transitive consumer (through shape-only ops) of
+    `var` uses it as a gather/scatter/dynamic-slice index."""
+    seen = set()
+    stack = [var]
+    while stack:
+        v = stack.pop()
+        if id(v) in seen:
+            continue
+        seen.add(id(v))
+        if id(v) in outvar_ids:
+            return False  # escapes this jaxpr — can't prove index-only
+        uses = consumers.get(id(v))
+        if not uses:
+            return False  # dead or untracked — be conservative
+        for eqn in uses:
+            idx_pos = set(_index_positions(eqn))
+            positions = [
+                i for i, iv in enumerate(eqn.invars) if iv is v
+            ]
+            if all(p in idx_pos for p in positions):
+                continue
+            if eqn.primitive.name in _SHAPE_ONLY:
+                stack.extend(eqn.outvars)
+                continue
+            return False
+    return True
+
+
+class _Walk:
+    """One taint-propagation walk over a closed jaxpr."""
+
+    def __init__(self) -> None:
+        self.casts: Counter = Counter()
+        self.sites: Dict[str, List[str]] = {}
+
+    def _tainted_outs(self, eqn, tin: List[bool]) -> List[bool]:
+        """Default propagation: any tainted input taints every wide-int
+        output (float/bool/narrow outputs are only reached via an
+        explicit convert, which is handled separately)."""
+        if not any(tin):
+            return [False] * len(eqn.outvars)
+        return [
+            str(v.aval.dtype) in _WIDE_INT for v in eqn.outvars
+        ]
+
+    def walk(self, jaxpr, taint_in: List[bool]) -> List[bool]:
+        """Returns the taint mask of jaxpr.outvars."""
+        j = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+        tainted: Set[int] = set()
+        consumers: Dict[int, list] = {}
+        for eqn in j.eqns:
+            for v in eqn.invars:
+                if not hasattr(v, "val"):
+                    consumers.setdefault(id(v), []).append(eqn)
+        outvar_ids = {id(v) for v in j.outvars}
+
+        def is_t(v) -> bool:
+            return not hasattr(v, "val") and id(v) in tainted
+
+        for var, t in zip(j.invars, taint_in):
+            if t:
+                tainted.add(id(var))
+
+        for eqn in j.eqns:
+            tin = [is_t(v) for v in eqn.invars]
+            name = eqn.primitive.name
+            if name == "convert_element_type" and tin[0]:
+                src = str(eqn.invars[0].aval.dtype)
+                dst = str(eqn.outvars[0].aval.dtype)
+                if src in _WIDE_INT:
+                    b = _bucket(dst)
+                    if b in ("to_i32", "to_i8") and _is_index_only(
+                        eqn.outvars[0], eqn, consumers, outvar_ids
+                    ):
+                        continue  # index lineage — bounded by geometry
+                    if b:
+                        self.casts[b] += 1
+                        self.sites.setdefault(b, []).append(
+                            f"{src}->{dst} at {eqn_source(eqn) or '?'}"
+                        )
+                        continue  # converted lineage is not re-tainted
+                # wide-int <-> wide-int keeps taint
+                if dst in _WIDE_INT:
+                    tainted.add(id(eqn.outvars[0]))
+                continue
+            tout = self._descend(eqn, tin)
+            for v, t in zip(eqn.outvars, tout):
+                if t:
+                    tainted.add(id(v))
+        return [is_t(v) for v in j.outvars]
+
+    def _descend(self, eqn, tin: List[bool]) -> List[bool]:
+        name = eqn.primitive.name
+        p = eqn.params
+        if name == "pjit" or (
+            "jaxpr" in p and name in ("closed_call", "shard_map")
+        ):
+            return self.walk(p["jaxpr"], tin)
+        if name in ("custom_jvp_call", "custom_vjp_call") and \
+                p.get("call_jaxpr") is not None:
+            return self.walk(p["call_jaxpr"], tin)
+        if name == "scan":
+            return self._fixpoint(
+                p["jaxpr"], tin, n_carry=p["num_carry"],
+                carry_lo=p["num_consts"],
+            )
+        if name == "while":
+            nc, nb = p["cond_nconsts"], p["body_nconsts"]
+            carry_in = tin[nc + nb:]
+            body_tin = tin[nc:nc + nb] + carry_in
+            out = self._fixpoint(
+                p["body_jaxpr"], body_tin, n_carry=len(carry_in),
+                carry_lo=nb,
+            )
+            return out
+        if name == "cond":
+            outs = None
+            for br in p["branches"]:
+                o = self.walk(br, tin[1:])
+                outs = o if outs is None else [
+                    a or b for a, b in zip(outs, o)
+                ]
+            return outs or [False] * len(eqn.outvars)
+        if name == "pallas_call":
+            # Opaque: the Pallas kernel body is differentially tested
+            # bit-exact against its XLA reference; taint stops here.
+            return [False] * len(eqn.outvars)
+        return self._tainted_outs(eqn, tin)
+
+    def _fixpoint(self, jaxpr, tin: List[bool], n_carry: int,
+                  carry_lo: int) -> List[bool]:
+        """Loop bodies: iterate until the carried taint stabilizes."""
+        tin = list(tin)
+        for _ in range(8):
+            out = self.walk(jaxpr, tin)
+            carry_out = out[:n_carry]
+            cur = tin[carry_lo:carry_lo + n_carry]
+            nxt = [a or b for a, b in zip(cur, carry_out)]
+            if nxt == cur:
+                return out
+            tin[carry_lo:carry_lo + n_carry] = nxt
+        return out
+
+
+class DtypeTaintChecker(Checker):
+    name = "dtype-taint"
+
+    def check(self, spec: KernelSpec, built: BuiltKernel,
+              ctx: RunContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for sig_name, make_args in built.signatures.items():
+            mask = taint_mask(make_args(), built.counters)
+            walk = _Walk()
+            walk.walk(ctx.jaxprs[spec.name][sig_name], mask)
+            allowed = Counter(built.allowed_casts)
+            for bucket, n in sorted(walk.casts.items()):
+                lim = allowed.get(bucket, 0)
+                if n > lim:
+                    extra = walk.sites[bucket][lim:]
+                    out.append(Finding(
+                        checker=self.name, kernel=spec.name,
+                        message=(
+                            f"[{sig_name}] {n} tainted {bucket} cast(s) "
+                            f"on int64 counter dataflow, budget {lim}; "
+                            "undeclared: " + "; ".join(extra[:4])
+                        ),
+                    ))
+            for bucket, lim in sorted(allowed.items()):
+                if walk.casts.get(bucket, 0) < lim:
+                    out.append(Finding(
+                        checker=self.name, kernel=spec.name,
+                        severity="warning",
+                        message=(
+                            f"[{sig_name}] declared {bucket} budget "
+                            f"{lim} but observed "
+                            f"{walk.casts.get(bucket, 0)} — shrink the "
+                            "declaration (stale budget hides the next "
+                            "regression)"
+                        ),
+                    ))
+            break  # taint structure is signature-invariant; one is enough
+        return out
