@@ -39,6 +39,61 @@ class BehaviorConfig:
 
 
 @dataclass
+class CircuitConfig:
+    """Per-peer circuit breaker (net/breaker.py; no reference analog —
+    the Go daemon spends the full RPC deadline against a dead peer on
+    every forwarded check).
+
+    Fed by the same failures that populate the 5-minute HealthCheck
+    error window: `failure_threshold` CONSECUTIVE failures trip the
+    breaker open; while open, every enqueue sheds immediately with
+    PeerNotReadyError (counted in `gubernator_peer_shed_total`) instead
+    of burning `batch_timeout_s` against a dead channel.  After a
+    jittered exponential backoff (`base_backoff_s * 2^(streak-1)`,
+    capped at `max_backoff_s`, ±`jitter`) the breaker goes half-open
+    and admits `half_open_probes` probe RPCs: one success re-closes it,
+    one failure re-opens with a doubled backoff."""
+
+    enabled: bool = True
+    failure_threshold: int = 5
+    base_backoff_s: float = 0.5
+    max_backoff_s: float = 30.0
+    jitter: float = 0.2  # fraction of the backoff, uniform ±
+    half_open_probes: int = 1
+
+    def __post_init__(self) -> None:
+        if self.failure_threshold < 1:
+            raise ValueError(
+                f"circuit failure_threshold must be >= 1, "
+                f"got {self.failure_threshold}"
+            )
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(
+                f"circuit jitter must be in [0, 1], got {self.jitter}"
+            )
+
+
+# Degraded-mode ownership fallback (runtime/service.py): what a node
+# answers when the owner of a forwarded key is unreachable (breaker
+# open or the ownership-retry loop exhausted).  "error" is the legacy
+# strict mode (the reference behavior: an error response, client
+# decides); the rest are the degraded-operation policies.
+DEGRADED_MODES = ("error", "fail_closed", "fail_open", "local_shadow")
+
+
+def normalize_degraded_mode(value: str) -> str:
+    """Canonicalize a degraded-mode policy; raise on anything unknown —
+    a typo must not silently fail open."""
+    v = (value or "").strip().lower() or "error"
+    if v not in DEGRADED_MODES:
+        raise ValueError(
+            f"unknown degraded mode {value!r}; expected one of "
+            + ", ".join(repr(m) for m in DEGRADED_MODES)
+        )
+    return v
+
+
+@dataclass
 class DeviceConfig:
     """TPU-specific geometry (no reference analog — replaces the Go worker
     pool's NumCPU/cache-per-worker arithmetic, workers.go:127-146).
@@ -138,6 +193,14 @@ class Config:
     loader: Optional[object] = None  # runtime.store.Loader
     store: Optional[object] = None  # runtime.store.Store
     sketch: Optional[SketchTierConfig] = None  # approximate tier
+    # Resilience plane (net/breaker.py + the degraded-mode ownership
+    # fallback in runtime/service.py).
+    circuit: CircuitConfig = field(default_factory=CircuitConfig)
+    degraded_mode: str = "error"  # see DEGRADED_MODES
+    # local_shadow: fraction of the limit a non-owner may admit from its
+    # shadow slot while the owner is gone (cluster-wide over-admission
+    # is bounded by peers * shadow_fraction * limit).
+    shadow_fraction: float = 0.5
 
 
 @dataclass
@@ -208,6 +271,19 @@ class DaemonConfig:
     # > 0: on breach, also start a time-boxed jax.profiler trace of this
     # many seconds under <flightrec_dir>/profile.
     flightrec_profile_s: float = 0.0
+    # Resilience plane: per-peer circuit breakers (net/breaker.py) and
+    # the degraded-mode ownership fallback (docs/resilience.md).
+    circuit: CircuitConfig = field(default_factory=CircuitConfig)
+    degraded_mode: str = "error"  # see DEGRADED_MODES
+    shadow_fraction: float = 0.5
+    # Chaos plane (testing/chaos.py): a seeded fault plan injected at
+    # the peer-client and daemon RPC boundaries.  `chaos_plan` is a JSON
+    # plan file (empty = no chaos — the production default); `chaos`
+    # accepts a pre-built ChaosInjector programmatically (the in-process
+    # cluster fixture).  `chaos_seed` > 0 overrides the plan's seed.
+    chaos_plan: str = ""
+    chaos_seed: int = 0
+    chaos: Optional[object] = None  # testing.chaos.ChaosInjector
 
 
 @dataclass
@@ -392,6 +468,27 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             batch_size=_env_int("GUBER_SKETCH_BATCH_SIZE", 1024),
             use_pallas=_env("GUBER_SKETCH_USE_PALLAS") == "true",
         )
+    circuit = CircuitConfig(
+        enabled=_env("GUBER_CIRCUIT_ENABLED", "true").lower()
+        not in ("0", "false", "no"),
+        failure_threshold=_require_min(
+            "GUBER_CIRCUIT_FAILURE_THRESHOLD",
+            _env_int("GUBER_CIRCUIT_FAILURE_THRESHOLD", 5), 1,
+        ),
+        base_backoff_s=_env_float_s("GUBER_CIRCUIT_BASE_BACKOFF", 0.5),
+        max_backoff_s=_env_float_s("GUBER_CIRCUIT_MAX_BACKOFF", 30.0),
+        jitter=float(_env("GUBER_CIRCUIT_JITTER", "0.2")),
+        half_open_probes=_require_min(
+            "GUBER_CIRCUIT_HALF_OPEN_PROBES",
+            _env_int("GUBER_CIRCUIT_HALF_OPEN_PROBES", 1), 1,
+        ),
+    )
+    shadow_fraction = float(_env("GUBER_DEGRADED_SHADOW_FRACTION", "0.5"))
+    if not 0.0 < shadow_fraction <= 1.0:
+        raise ValueError(
+            "GUBER_DEGRADED_SHADOW_FRACTION must be in (0, 1], got "
+            f"{shadow_fraction}"
+        )
     return DaemonConfig(
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "localhost:1051"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "localhost:1050"),
@@ -439,6 +536,13 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         slo_p99_ms=float(_env("GUBER_SLO_P99_MS", "2.0")),
         flightrec_profile_s=_env_float_s("GUBER_FLIGHTREC_PROFILE", 0.0),
+        circuit=circuit,
+        degraded_mode=normalize_degraded_mode(
+            _env("GUBER_DEGRADED_MODE", "error")
+        ),
+        shadow_fraction=shadow_fraction,
+        chaos_plan=_env("GUBER_CHAOS_PLAN", ""),
+        chaos_seed=_env_int("GUBER_CHAOS_SEED", 0),
     )
 
 

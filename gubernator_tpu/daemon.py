@@ -202,6 +202,18 @@ class Daemon:
                     self.metrics.registry.register(c)
                 except ValueError:
                     pass  # another daemon in this process registered them
+        # Chaos plane (testing/chaos.py): a pre-built injector from the
+        # cluster fixture, or a JSON plan file via GUBER_CHAOS_PLAN.
+        self.chaos = self.conf.chaos
+        if self.chaos is None and getattr(self.conf, "chaos_plan", ""):
+            from gubernator_tpu.testing.chaos import ChaosInjector, load_plan
+
+            self.chaos = ChaosInjector(
+                load_plan(
+                    self.conf.chaos_plan,
+                    seed_override=self.conf.chaos_seed or None,
+                )
+            )
         self.service: Optional[Service] = None
         self.fastpath = None
         self._grpc_server: Optional[grpc.aio.Server] = None
@@ -229,6 +241,9 @@ class Daemon:
             loader=getattr(self.conf, "loader", None),
             store=getattr(self.conf, "store", None),
             sketch=getattr(self.conf, "sketch", None),
+            circuit=getattr(self.conf, "circuit", None) or Config().circuit,
+            degraded_mode=getattr(self.conf, "degraded_mode", "error"),
+            shadow_fraction=getattr(self.conf, "shadow_fraction", 0.5),
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -254,11 +269,20 @@ class Daemon:
         # 4MB recv cap: grpc-go's default, which reference peers assume.
         # Count-capped peer batches (batch_limit=1000) with long key strings
         # can pass 1MB, and a rejected batch fails every flush window.
+        interceptors = [_StatsInterceptor(self.metrics)]
+        if self.chaos is not None:
+            from gubernator_tpu.testing.chaos import ChaosServerInterceptor
+
+            # Daemon-boundary fault injection; addr resolves lazily
+            # (the ephemeral port isn't bound yet).
+            interceptors.append(
+                ChaosServerInterceptor(self.chaos, lambda: self.grpc_address)
+            )
         server = grpc.aio.server(
             options=[
                 ("grpc.max_receive_message_length", 4 * 1024 * 1024),
             ],
-            interceptors=[_StatsInterceptor(self.metrics)],
+            interceptors=interceptors,
         )
         server.add_generic_rpc_handlers((
             grpc_api.v1_generic_handler(_V1Servicer(self), raw=True),
@@ -321,6 +345,10 @@ class Daemon:
                 raise
         # Rewrite :0 ephemeral binds to the actual port for advertisement.
         self.grpc_address = f"{host}:{port}"
+        if self.chaos is not None:
+            # Bind the injector to our (now-known) address; every
+            # PeerClient built from here on carries the hook.
+            self.service.chaos = self.chaos.bind(self.grpc_address)
 
         await self._start_http()
         await self._start_discovery()
@@ -455,6 +483,10 @@ class Daemon:
                 self.metrics.peer_error_window.labels(
                     peerAddr=peer.info().grpc_address
                 ).set(len(peer.last_errors()))
+                if peer.breaker is not None:
+                    self.metrics.circuit_state.labels(
+                        peerAddr=peer.info().grpc_address
+                    ).set(int(peer.breaker.state))
         return web.Response(
             body=self.metrics.render(),
             content_type="text/plain",
@@ -505,6 +537,17 @@ class Daemon:
             out["peers"] = {
                 p.info().grpc_address: len(p.last_errors())
                 for p in s.peer_list() + s.region_picker.peers()
+            }
+            out["circuits"] = {
+                p.info().grpc_address: p.circuit_snapshot()
+                for p in s.peer_list() + s.region_picker.peers()
+            }
+            out["degraded"] = {
+                "mode": s.cfg.degraded_mode,
+                "served": s.degraded_served,
+                "shadow_owners": {
+                    addr: len(keys) for addr, keys in s._shadow.items()
+                },
             }
         fp = self.fastpath
         if fp is not None:

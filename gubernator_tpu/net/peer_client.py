@@ -23,7 +23,7 @@ from typing import Deque, List, Optional, Tuple
 import grpc
 import grpc.aio
 
-from gubernator_tpu.core.config import BehaviorConfig
+from gubernator_tpu.core.config import BehaviorConfig, CircuitConfig
 from gubernator_tpu.core.types import (
     Behavior,
     PeerInfo,
@@ -33,6 +33,7 @@ from gubernator_tpu.core.types import (
     has_behavior,
 )
 from gubernator_tpu.net import grpc_api
+from gubernator_tpu.net.breaker import CircuitBreaker, CircuitState
 from gubernator_tpu.proto import peers_pb2
 
 ERROR_WINDOW_S = 300.0  # keep peer errors 5 min (peer_client.go:282)
@@ -105,10 +106,30 @@ class PeerClient:
         behavior: Optional[BehaviorConfig] = None,
         channel_credentials: Optional[grpc.ChannelCredentials] = None,
         metrics=None,
+        circuit: Optional[CircuitConfig] = None,
+        chaos=None,
     ) -> None:
         self.peer_info = info
         self.metrics = metrics
         self.behavior = behavior or BehaviorConfig()
+        # Per-peer circuit breaker (net/breaker.py): fed by the same
+        # failures as the health window, gates every RPC path.  A None
+        # breaker (circuit.enabled=False) restores the pre-breaker
+        # behavior exactly.
+        cc = circuit if circuit is not None else CircuitConfig()
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(cc, on_transition=self._on_circuit_transition)
+            if cc.enabled
+            else None
+        )
+        # Chaos hook (testing/chaos.py): awaited immediately before each
+        # outbound RPC; may delay or raise a fabricated AioRpcError.
+        self.chaos = chaos
+        # Success observer (runtime/service.py): ANY successful RPC to
+        # this peer — object path, compiled raw lane, GLOBAL flush or
+        # broadcast — proves the peer healed, so the service can drop
+        # its degraded-mode shadow state for it.
+        self.on_rpc_success = None
         self._creds = channel_credentials
         self._channel: Optional[grpc.aio.Channel] = None
         self._stub: Optional[grpc_api.PeersV1Stub] = None
@@ -150,6 +171,57 @@ class PeerClient:
         no delivered-but-unanswered window, unlike a passive readiness
         watcher which can miss a short-lived READY."""
         return self._ever_ready
+
+    # -- circuit breaker -------------------------------------------------
+    def circuit_state_name(self) -> str:
+        return (
+            "disabled" if self.breaker is None
+            else self.breaker.state_name()
+        )
+
+    def circuit_open(self) -> bool:
+        """True while the breaker is open with backoff still running —
+        the degraded-mode fallback's fast-fail signal."""
+        return self.breaker is not None and self.breaker.fast_fail()
+
+    def circuit_snapshot(self) -> dict:
+        if self.breaker is None:
+            return {"state": "disabled"}
+        return self.breaker.snapshot()
+
+    def _on_circuit_transition(
+        self, old: CircuitState, new: CircuitState
+    ) -> None:
+        if self.metrics is not None:
+            self.metrics.circuit_state.labels(
+                peerAddr=self.peer_info.grpc_address
+            ).set(int(new))
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record(
+                    "circuit",
+                    peer=self.peer_info.grpc_address,
+                    frm=old.name.lower(),
+                    to=new.name.lower(),
+                )
+
+    def _shed(self, reason: str) -> PeerNotReadyError:
+        """Count a pre-RPC shed (`peer_shed_total{reason}`) and build
+        the PeerNotReadyError for the caller to raise.  Sheds are NOT
+        `_record_error`d: they never reached the peer, so they belong in
+        neither the health window nor the breaker's failure count (an
+        open breaker must not feed itself)."""
+        if self.metrics is not None:
+            self.metrics.peer_shed_total.labels(
+                peerAddr=self.peer_info.grpc_address, reason=reason
+            ).inc()
+        detail = {
+            "queue_full": "batch queue full",
+            "breaker_open": "circuit breaker open",
+        }.get(reason, reason)
+        return PeerNotReadyError(
+            f"peer {self.peer_info.grpc_address} shed request: {detail}"
+        )
 
     async def _ensure_ready(self) -> float:
         """Pre-dial gate: on a channel that has never been READY, wait
@@ -241,6 +313,10 @@ class PeerClient:
             raise PeerNotReadyError(
                 f"peer {self.peer_info.grpc_address} is shut down"
             )
+        if self.breaker is not None and not self.breaker.would_allow():
+            # Fast-fail: an open breaker sheds at the enqueue gate —
+            # no dial, no deadline burned against a dead channel.
+            raise self._shed("breaker_open")
         self._track_inflight(+1)
         try:
             if has_behavior(req.behavior, Behavior.NO_BATCHING):
@@ -256,9 +332,7 @@ class PeerClient:
             try:
                 self._queue.put_nowait((req, fut))
             except asyncio.QueueFull as e:
-                raise PeerNotReadyError(
-                    f"peer {self.peer_info.grpc_address} batch queue full"
-                ) from e
+                raise self._shed("queue_full") from e
             return await fut
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
@@ -281,6 +355,8 @@ class PeerClient:
             raise PeerNotReadyError(
                 f"peer {self.peer_info.grpc_address} is shut down"
             )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
         self._track_inflight(+1)
         try:
             return await self._call_get_peer_rate_limits(reqs)
@@ -304,14 +380,22 @@ class PeerClient:
             raise PeerNotReadyError(
                 f"peer {self.peer_info.grpc_address} is shut down"
             )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
         self._track_inflight(+1)
         try:
             await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
             budget = await self._ensure_ready()
+            if self.chaos is not None:
+                await self.chaos.on_client(
+                    self.peer_info.grpc_address, "GetPeerRateLimits"
+                )
             out = await self._raw_get_peer_rate_limits(
                 payload, timeout=budget
             )
-            self._ever_ready = True
+            self._record_success()
             return out
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
@@ -328,15 +412,23 @@ class PeerClient:
             raise PeerNotReadyError(
                 f"peer {self.peer_info.grpc_address} is shut down"
             )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
         self._track_inflight(+1)
         try:
             stub = await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
             budget = await self._ensure_ready()
+            if self.chaos is not None:
+                await self.chaos.on_client(
+                    self.peer_info.grpc_address, "UpdatePeerGlobals"
+                )
             req = peers_pb2.UpdatePeerGlobalsReq(
                 globals=[grpc_api.global_to_pb(g) for g in globals_]
             )
             await stub.UpdatePeerGlobals(req, timeout=budget)
-            self._ever_ready = True
+            self._record_success()
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
             raise
@@ -372,8 +464,22 @@ class PeerClient:
         cutoff = time.monotonic() - ERROR_WINDOW_S
         return [msg for ts, msg in self._errors if ts >= cutoff]
 
+    def _record_success(self) -> None:
+        """One successful RPC: marks the channel ever-ready (the
+        provably_unsent structural signal), feeds the breaker, and
+        notifies the heal observer."""
+        self._ever_ready = True
+        if self.breaker is not None:
+            self.breaker.record_success()
+        if self.on_rpc_success is not None:
+            self.on_rpc_success()
+
     def _record_error(self, msg: str) -> None:
         self._errors.append((time.monotonic(), msg))
+        if self.breaker is not None:
+            # The breaker's failure feed IS the health window's: every
+            # recorded peer error counts, nothing else does.
+            self.breaker.record_failure()
         if self.metrics is not None:
             self.metrics.peer_error_total.labels(
                 peerAddr=self.peer_info.grpc_address
@@ -491,10 +597,18 @@ class PeerClient:
         self, reqs: List[RateLimitReq]
     ) -> List[RateLimitResp]:
         stub = await self._connect()
+        if self.breaker is not None and not self.breaker.allow():
+            # The RPC-issue gate: one batched send is one half-open
+            # probe; anything past the probe budget sheds here.
+            raise self._shed("breaker_open")
         budget = await self._ensure_ready()
+        if self.chaos is not None:
+            await self.chaos.on_client(
+                self.peer_info.grpc_address, "GetPeerRateLimits"
+            )
         pb_req = peers_pb2.GetPeerRateLimitsReq(
             requests=[grpc_api.req_to_pb(r) for r in reqs]
         )
         pb_resp = await stub.GetPeerRateLimits(pb_req, timeout=budget)
-        self._ever_ready = True
+        self._record_success()
         return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

@@ -153,6 +153,27 @@ class Metrics:
             ["peerAddr"],
             registry=r,
         )
+        self.peer_shed_total = Counter(
+            "gubernator_peer_shed_total",
+            "Peer-client enqueues shed before any RPC was issued, by "
+            "reason (queue_full | breaker_open).",
+            ["peerAddr", "reason"],
+            registry=r,
+        )
+        self.circuit_state = Gauge(
+            "gubernator_circuit_state",
+            "Per-peer circuit-breaker state (0=closed, 1=open, "
+            "2=half_open); refreshed at scrape and on transition.",
+            ["peerAddr"],
+            registry=r,
+        )
+        self.degraded_total = Counter(
+            "gubernator_degraded_total",
+            "Responses served by the degraded-mode ownership fallback "
+            "while the owner peer was unreachable, by mode.",
+            ["mode"],  # fail_closed | fail_open | local_shadow
+            registry=r,
+        )
 
         # -- GLOBAL replication (global.go:48-57) -------------------------
         self.async_durations = Histogram(

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -48,6 +49,7 @@ from gubernator_tpu.core.types import (
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
+    Status,
     UpdatePeerGlobal,
     has_behavior,
 )
@@ -71,6 +73,28 @@ HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
 
 ASYNC_RETRIES = 5  # forwarded-request ownership-change retries (gubernator.go:350)
+
+# The shadow slot's key suffix: a degraded local_shadow check serves
+# from `<unique_key>` + this suffix, so shadow admission state never
+# collides with the real key's authoritative or cached rows.
+SHADOW_SUFFIX = ".degraded-shadow"
+
+
+def forward_backoff_s(
+    attempt: int, cap_s: float, rng: random.Random
+) -> float:
+    """Backoff before ownership-retry `attempt` (1-based) of the
+    forwarded-request loop: equal-jittered exponential —
+    uniform over [base/2, base] with base = 10ms * 2^(attempt-1) —
+    capped at `cap_s` (the batch timeout, so the retry loop's total
+    added latency stays within one RPC budget).  Jitter decorrelates
+    the retry stampede a dying owner otherwise sees from every
+    forwarder at once (the coordination failure arXiv:1909.08969
+    measures).  Pure function of (attempt, cap, rng) so tests pin the
+    schedule with a seeded rng."""
+    base = min(0.01 * (2 ** max(attempt - 1, 0)), cap_s)
+    lo = base / 2.0
+    return min(lo + rng.random() * (base - lo), cap_s)
 
 
 class ApiError(Exception):
@@ -121,6 +145,17 @@ class Service:
             )
         self._inflight_checks = 0
         self._peer_credentials = peer_credentials
+        # Chaos binding (testing/chaos.py): set by the daemon after its
+        # listen address is known, handed to every PeerClient built
+        # afterwards.  None in production.
+        self.chaos = None
+        # Degraded-mode ownership fallback (docs/resilience.md).
+        self._rng = random.Random()
+        self.degraded_served = 0
+        # owner addr -> {shadow hash_key: the RESET_REMAINING req that
+        # drops the shadow slot once the owner heals}.
+        self._shadow: Dict[str, Dict[str, RateLimitReq]] = {}
+        self._shadow_tasks: set = set()
         # Cached label child: the hot path must not pay a labels() dict
         # lookup per call (reference funcTimeMetric, gubernator.go:118).
         self._fd_get_rate_limits = self.metrics.func_duration.labels(
@@ -277,12 +312,20 @@ class Service:
             )
 
     def _new_peer(self, info: PeerInfo) -> PeerClient:
-        return PeerClient(
+        peer = PeerClient(
             info,
             behavior=self.cfg.behaviors,
             channel_credentials=self._peer_credentials,
             metrics=self.metrics,
+            circuit=self.cfg.circuit,
+            chaos=self.chaos,
         )
+        # Heal detection for the degraded-mode fallback: ANY successful
+        # RPC to the peer (object path, compiled raw lane, GLOBAL
+        # flush/broadcast) drops its shadow admission state.
+        addr = info.grpc_address
+        peer.on_rpc_success = lambda: self._drop_shadow(addr)
+        return peer
 
     def get_peer(self, key: str) -> PeerClient:
         """Owning peer for a hash key (gubernator.go:719-731)."""
@@ -556,18 +599,30 @@ class Service:
     ) -> RateLimitResp:
         """Forward to the owning peer; on NotReady re-resolve the owner (it
         may now be us) up to 5 times (asyncRequests, gubernator.go:327-416).
+        When the owner's breaker is open, or the retry loop exhausts, the
+        configured GUBER_DEGRADED_MODE policy decides the answer
+        (docs/resilience.md).
         """
         attempts = 0
         last_err: Optional[Exception] = None
+        cap_s = self.cfg.behaviors.batch_timeout_s
+        degraded = self.cfg.degraded_mode != "error"
         while True:
             if attempts > ASYNC_RETRIES:
-                return RateLimitResp(
-                    error="GetPeer() keeps returning peers that are not "
-                    f"connected for '{key}': {last_err}"
-                )
+                return await self._degraded_response(req, key, peer, last_err)
             if attempts != 0 and peer.info().is_owner:
                 resps = await self._check_local([req])
                 return resps[0]
+            if degraded and peer.circuit_open():
+                # The owner is known-dead (breaker open, backoff running):
+                # re-resolving the ring would hand back the same peer, so
+                # serve the degraded policy without burning the retry loop.
+                return await self._degraded_response(
+                    req, key, peer,
+                    last_err or PeerNotReadyError(
+                        f"circuit open for {peer.info().grpc_address}"
+                    ),
+                )
             try:
                 self.metrics.getratelimit_counter.labels("forward").inc()
                 resp = await peer.get_peer_rate_limit(req)
@@ -579,6 +634,8 @@ class Service:
                 md = dict(resp.metadata) if resp.metadata else {}
                 md["owner"] = peer.info().grpc_address
                 resp.metadata = md
+                # (Shadow drop on heal rides peer.on_rpc_success — it
+                # fires for this success and every other RPC path.)
                 return resp
             except PeerNotReadyError as e:
                 last_err = e
@@ -589,9 +646,13 @@ class Service:
                 # Back off before re-resolving: immediate retries against a
                 # dying peer all complete before any discovery update can
                 # land (the reference retries after the peer's reconnect
-                # backoff).  Exponential 10ms..160ms keeps total added
-                # latency under the 500ms batch timeout.
-                await asyncio.sleep(min(0.01 * (2 ** (attempts - 1)), 0.16))
+                # backoff).  Equal-jittered exponential (10ms.. doubling,
+                # capped at the batch timeout) keeps total added latency
+                # within one RPC budget while decorrelating the retry
+                # stampede across forwarders.
+                await asyncio.sleep(
+                    forward_backoff_s(attempts, cap_s, self._rng)
+                )
                 try:
                     peer = self.get_peer(key)
                 except PoolEmptyError as pe:
@@ -604,6 +665,128 @@ class Service:
                     error=f"Error while fetching rate limit '{key}' "
                     f"from peer: {e}"
                 )
+
+    # ------------------------------------------------------------------
+    # degraded-mode ownership fallback (docs/resilience.md)
+    # ------------------------------------------------------------------
+    async def _degraded_response(
+        self,
+        req: RateLimitReq,
+        key: str,
+        peer: PeerClient,
+        last_err: Optional[Exception],
+    ) -> RateLimitResp:
+        """The answer while the owner is gone, per GUBER_DEGRADED_MODE:
+
+        error        the legacy strict contract — an error response, the
+                     client decides (reference gubernator.go:358-366);
+        fail_closed  deny: OVER_LIMIT, remaining=0 (an outage admits
+                     nothing extra, at the price of rejecting legitimate
+                     traffic);
+        fail_open    admit: UNDER_LIMIT at the full limit (availability
+                     over enforcement — unbounded over-admission while
+                     degraded);
+        local_shadow serve from a LOCAL shadow slot in the device table
+                     at `shadow_fraction` of the limit: each non-owner
+                     admits at most fraction*limit per window, bounding
+                     cluster-wide over-admission to peers * fraction *
+                     limit while keeping per-client fairness.  Shadow
+                     state is reset when the owner heals.
+
+        All degraded answers tag `metadata["degraded"]` so clients and
+        tests can distinguish them from authoritative decisions."""
+        mode = self.cfg.degraded_mode
+        if mode == "error":
+            return RateLimitResp(
+                error="GetPeer() keeps returning peers that are not "
+                f"connected for '{key}': {last_err}"
+            )
+        owner = peer.info().grpc_address
+        self.degraded_served += 1
+        self.metrics.degraded_total.labels(mode=mode).inc()
+        fr = getattr(self.metrics, "flightrec", None)
+        if fr is not None:
+            fr.record("degraded", mode=mode, key=key, owner=owner)
+        now_ms = int(self.clock.now_ns() // 1_000_000)
+        reset_ms = now_ms + max(int(req.duration), 0)
+        if mode == "fail_closed":
+            return RateLimitResp(
+                status=Status.OVER_LIMIT,
+                limit=req.limit,
+                remaining=0,
+                reset_time=reset_ms,
+                metadata={"degraded": mode, "owner": owner},
+            )
+        if mode == "fail_open":
+            return RateLimitResp(
+                status=Status.UNDER_LIMIT,
+                limit=req.limit,
+                remaining=max(req.limit - req.hits, 0),
+                reset_time=reset_ms,
+                metadata={"degraded": mode, "owner": owner},
+            )
+        # local_shadow
+        from dataclasses import replace as dc_replace
+
+        shadow_limit = max(1, int(req.limit * self.cfg.shadow_fraction))
+        shadow = dc_replace(
+            req,
+            unique_key=req.unique_key + SHADOW_SUFFIX,
+            limit=shadow_limit,
+            burst=min(req.burst, shadow_limit) if req.burst else 0,
+            behavior=Behavior(
+                int(req.behavior)
+                & ~int(Behavior.GLOBAL)
+                & ~int(Behavior.MULTI_REGION)
+            ),
+        )
+        resps = await self._check_local([shadow])
+        resp = resps[0]
+        if not resp.error:
+            md = dict(resp.metadata) if resp.metadata else {}
+            md["degraded"] = mode
+            md["owner"] = owner
+            resp.metadata = md
+            # Remember how to drop this shadow slot on heal: a zero-hit
+            # RESET_REMAINING removes a token-bucket row outright
+            # (algorithms.go:78-90) and re-fills a leaky one — either
+            # way no stale shadow admission state survives the owner
+            # becoming authoritative again.
+            self._shadow.setdefault(owner, {})[shadow.hash_key()] = (
+                dc_replace(
+                    shadow,
+                    hits=0,
+                    behavior=Behavior(
+                        int(shadow.behavior)
+                        | int(Behavior.RESET_REMAINING)
+                    ),
+                )
+            )
+        return resp
+
+    def _drop_shadow(self, addr: str) -> None:
+        """The owner healed: reset its shadow slots (fire-and-forget —
+        the healed forward that triggered this must not wait on it)."""
+        pending = self._shadow.pop(addr, None)
+        if not pending:
+            return
+        resets = list(pending.values())
+
+        async def reset() -> None:
+            try:
+                await self._check_local(resets)
+                fr = getattr(self.metrics, "flightrec", None)
+                if fr is not None:
+                    fr.record("shadow_drop", owner=addr, keys=len(resets))
+            except Exception as e:  # noqa: BLE001 — slots expire anyway
+                log.warning(
+                    "shadow reset after owner %s healed failed: %s",
+                    addr, e,
+                )
+
+        t = asyncio.ensure_future(reset())
+        self._shadow_tasks.add(t)
+        t.add_done_callback(self._shadow_tasks.discard)
 
     # ------------------------------------------------------------------
     # peer-facing API (server side)
@@ -667,6 +850,19 @@ class Service:
             for msg in peer.last_errors():
                 errs.append(
                     f"Error returned from region peer.GetLastErr: {msg}"
+                )
+        # Circuit plane: an open/half-open breaker is a live statement
+        # that a peer is being shed — surface it even after the error
+        # window has pruned the failures that tripped it.
+        for peer in local_peers + region_peers:
+            state = peer.circuit_state_name()
+            if state in ("open", "half_open"):
+                snap = peer.circuit_snapshot()
+                errs.append(
+                    f"Circuit {state} for peer "
+                    f"{peer.info().grpc_address} (trips="
+                    f"{snap.get('trips', 0)}, reopens in "
+                    f"{snap.get('open_remaining_s', 0.0):g}s)"
                 )
         h = HealthCheckResp(
             status=HEALTHY, peer_count=len(local_peers) + len(region_peers)

@@ -1,2 +1,8 @@
-"""Test infrastructure: the in-process multi-daemon cluster fixture."""
+"""Test infrastructure: the in-process multi-daemon cluster fixture and
+the chaos plane (deterministic fault injection, testing/chaos.py)."""
+from gubernator_tpu.testing.chaos import (  # noqa: F401
+    ChaosInjector,
+    ChaosPlan,
+    Rule,
+)
 from gubernator_tpu.testing.cluster import Cluster  # noqa: F401

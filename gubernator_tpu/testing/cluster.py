@@ -153,6 +153,20 @@ class Cluster:
                 return d
         raise KeyError(addr)
 
+    def breaker_states(self) -> dict:
+        """{daemon addr: {peer addr: circuit state name}} — the chaos
+        tests' "every opened breaker re-closed after heal" probe."""
+        out: dict = {}
+        for d in self.daemons:
+            if d.service is None:
+                continue
+            out[d.grpc_address] = {
+                p.info().grpc_address: p.circuit_state_name()
+                for p in d.service.peer_list()
+                if not p.info().is_owner
+            }
+        return out
+
     def kill(self, idx: int) -> None:
         """Hard-stop one daemon, keeping its slot in the list
         (functional_test.go:1063-1071 kills daemons for health tests)."""

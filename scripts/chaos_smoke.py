@@ -1,0 +1,192 @@
+"""CI smoke: a short seeded fault plan against a 3-daemon in-process
+cluster (the compressed version of tests/test_chaos.py).
+
+Boots three real daemons on one loop with per-peer circuit breakers,
+`local_shadow` degraded mode and the flight recorder armed, injects a
+seeded storm of client/server faults (>=30% of peer RPCs fail), then
+asserts the resilience invariants end to end:
+
+  * zero double counts — every key's applied hits on its owner equal
+    exactly the successful responses the client saw;
+  * at least one breaker tripped during the storm;
+  * after heal, every opened breaker re-closes and forwards succeed.
+
+On any failure each daemon's flight recorder dumps its ring to
+GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
+can pick the evidence up.
+
+Run from the repo root:  python scripts/chaos_smoke.py [--seed N]
+The whole run is deterministic given the seed (docs/resilience.md).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable from a checkout without an installed package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LIMIT = 1000
+DURATION = 60_000
+KEYS = 20
+ROUNDS = 5
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1337)
+    args = ap.parse_args()
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import CircuitConfig, DaemonConfig
+    from gubernator_tpu.core.types import RateLimitReq
+    from gubernator_tpu.testing import (
+        ChaosInjector,
+        ChaosPlan,
+        Cluster,
+        Rule,
+    )
+
+    injector = ChaosInjector(ChaosPlan(seed=args.seed))
+    injector.set_active(False)  # boot/peer-discovery runs clean
+    cluster = Cluster.start_with(
+        ["", "", ""],
+        conf_template=DaemonConfig(
+            # Fast breaker schedule so open -> half-open -> re-close
+            # cycles fit a smoke budget.
+            circuit=CircuitConfig(
+                failure_threshold=3, base_backoff_s=0.1,
+                max_backoff_s=1.0, jitter=0.2,
+            ),
+            degraded_mode="local_shadow",
+            shadow_fraction=0.25,
+            chaos=injector,
+            flightrec=True,
+            flightrec_dir=os.environ.get(
+                "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+            ),
+        ),
+    )
+
+    def dump_flightrec(reason: str) -> None:
+        for d in cluster.daemons:
+            if d.flightrec is not None:
+                path = cluster.run(d.flightrec.dump(reason))
+                print(f"flightrec dump ({d.grpc_address}): {path}")
+
+    try:
+        # The same fault mix as test_seeded_plan_no_double_count, with
+        # the hard-failure rates bumped so the >=30% floor holds at
+        # smoke sample sizes: unsent client errors (retry-safe),
+        # pre-apply server rejections, drops and delays.
+        injector.reset(ChaosPlan(seed=args.seed, rules=[
+            Rule(op="error", where="client", method="GetPeerRateLimits",
+                 probability=0.28, status="UNAVAILABLE",
+                 message="injected: failed to connect to all addresses"),
+            Rule(op="error", where="server", phase="before",
+                 method="GetPeerRateLimits", probability=0.15,
+                 status="UNAVAILABLE",
+                 message="injected: refused before apply"),
+            Rule(op="drop", where="client", method="GetPeerRateLimits",
+                 probability=0.04, delay_s=0.01),
+            Rule(op="delay", where="client", method="GetPeerRateLimits",
+                 probability=0.10, delay_s=0.005),
+        ]))
+
+        keys = [f"smoke{i}" for i in range(KEYS)]
+        ok = {k: 0 for k in keys}
+        cl = V1Client(cluster.addresses()[0])
+        try:
+            for _round in range(ROUNDS):
+                for k in keys:
+                    r = cl.get_rate_limits([
+                        RateLimitReq(
+                            name="chaos", unique_key=k, hits=1,
+                            limit=LIMIT, duration=DURATION,
+                        )
+                    ], timeout=30)[0]
+                    if r.error == "" and "degraded" not in (r.metadata or {}):
+                        ok[k] += 1
+        finally:
+            cl.close()
+
+        frac = injector.failure_fraction()
+        assert frac >= 0.30, (
+            f"storm too mild: {frac:.0%} injected failures "
+            f"({dict(injector.injected)})"
+        )
+
+        forwarded = 0
+        for k in keys:
+            hash_key = f"chaos_{k}"
+            owner = cluster.owner_daemon_of(hash_key)
+            if owner is not cluster.daemons[0]:
+                forwarded += 1
+            it = owner.service.backend.get_cache_item(hash_key)
+            applied = 0 if it is None else LIMIT - int(it.remaining)
+            assert applied == ok[k], (
+                f"key {k}: owner applied {applied}, client saw "
+                f"{ok[k]} successes — double count or lost hit"
+            )
+        assert forwarded >= 5, f"only {forwarded} keys forwarded"
+
+        trips = sum(
+            p.breaker.trips
+            for d in cluster.daemons
+            for p in d.service.peer_list()
+            if p.breaker is not None and not p.info().is_owner
+        )
+        assert trips >= 1, "no breaker tripped during the storm"
+
+        # Heal; probe from every daemon until every breaker re-closes.
+        injector.heal()
+        clients = [V1Client(a) for a in cluster.addresses()]
+        try:
+            deadline = time.monotonic() + 20.0
+            while True:
+                for c2 in clients:
+                    c2.get_rate_limits([
+                        RateLimitReq(
+                            name="quiesce",
+                            unique_key=f"q{random.random()}",
+                            hits=1, limit=LIMIT, duration=DURATION,
+                        )
+                        for _ in range(4)
+                    ], timeout=30)
+                states = cluster.breaker_states()
+                stuck = [
+                    (a, pa, s)
+                    for a, peers in states.items()
+                    for pa, s in peers.items()
+                    if s not in ("closed", "disabled")
+                ]
+                if not stuck:
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"breakers never re-closed after heal: {stuck}"
+                    )
+                time.sleep(0.1)
+        finally:
+            for c2 in clients:
+                c2.close()
+
+        print(
+            f"chaos smoke OK: seed={args.seed} "
+            f"injected={frac:.0%} of {injector.attempts['client']} "
+            f"client RPCs, trips={trips}, forwarded_keys={forwarded}, "
+            f"all breakers re-closed"
+        )
+    except BaseException:
+        dump_flightrec("chaos-smoke-failure")
+        raise
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

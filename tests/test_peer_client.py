@@ -309,6 +309,194 @@ def test_ever_connected_tracking():
         c.stop()
 
 
+def test_peer_shed_counter_distinguishes_reasons():
+    """Satellite: silent PeerNotReadyError sheds are now counted per
+    reason — queue_full (backpressure) vs breaker_open (circuit) are
+    different operational problems and must be distinguishable from a
+    scrape."""
+    from gubernator_tpu.core.config import CircuitConfig
+    from gubernator_tpu.runtime.metrics import Metrics
+
+    async def scenario():
+        m = Metrics()
+        addr = "127.0.0.1:1"
+        pc = PeerClient(
+            PeerInfo(grpc_address=addr),
+            behavior=BehaviorConfig(batch_wait_s=30.0),
+            metrics=m,
+            circuit=CircuitConfig(
+                failure_threshold=1, base_backoff_s=60.0
+            ),
+        )
+
+        def shed_count(reason: str) -> float:
+            return m.registry.get_sample_value(
+                "gubernator_peer_shed_total",
+                {"peerAddr": addr, "reason": reason},
+            ) or 0.0
+
+        # queue_full: stuff the batch queue (the batcher is parked on a
+        # 30s window after the first dequeue), then overflow it.
+        fill = asyncio.Queue(maxsize=2)
+        pc._queue = fill
+        loop = asyncio.get_running_loop()
+        fill.put_nowait((None, loop.create_future()))
+        fill.put_nowait((None, loop.create_future()))
+        req = RateLimitReq(
+            name="shed", unique_key="k", hits=1, limit=1, duration=1000
+        )
+        with pytest.raises(PeerNotReadyError, match="queue full"):
+            await pc.get_peer_rate_limit(req)
+        assert shed_count("queue_full") == 1
+        assert shed_count("breaker_open") == 0
+
+        # breaker_open: one recorded failure trips the threshold-1
+        # breaker; the next enqueue fast-fails at the gate.
+        pc._record_error("injected failure")
+        assert pc.circuit_state_name() == "open"
+        with pytest.raises(PeerNotReadyError, match="breaker open"):
+            await pc.get_peer_rate_limit(req)
+        assert shed_count("breaker_open") == 1
+        assert shed_count("queue_full") == 1  # unchanged
+        # The gauge followed the transition.
+        assert m.registry.get_sample_value(
+            "gubernator_circuit_state", {"peerAddr": addr}
+        ) == 1.0
+        # Sheds are NOT peer errors: neither the health window nor the
+        # breaker's failure count may feed on them.
+        assert len(pc.last_errors()) == 1
+        pc._shutdown = True
+        await pc.shutdown()
+
+    run(scenario())
+
+
+def test_provably_unsent_marker_table():
+    """Satellite: table-driven coverage of the connect-phase marker
+    wordings across grpc-core versions — each marker must classify
+    from details() alone AND from debug_error_string() alone, case-
+    insensitively, while mid-RPC wordings never classify."""
+    import grpc
+
+    from gubernator_tpu.net.peer_client import (
+        _UNSENT_MARKERS,
+        provably_unsent,
+    )
+
+    class FakeRpcError(Exception):
+        def __init__(self, code, details=None, debug=None):
+            self._c, self._d, self._dbg = code, details, debug
+
+        def code(self):
+            return self._c
+
+        def details(self):
+            return self._d
+
+        def debug_error_string(self):
+            return self._dbg
+
+    class FakePeer:
+        def __init__(self, ever):
+            self._ever = ever
+
+        def ever_connected(self):
+            return self._ever
+
+    # Observed wordings per marker: (current grpc-core details(), older
+    # debug_error_string() JSON) — both fields must classify alone.
+    wordings = {
+        "failed to connect":
+            ("failed to connect to all addresses",
+             '{"description":"Failed to connect to remote host"}'),
+        "connection refused":
+            ("connection refused",
+             '{"grpc_status":14,"description":"Connection refused"}'),
+        "connect failed":
+            ("connect failed: no route to host",
+             '{"description":"Connect Failed","file":"tcp_client.cc"}'),
+        "no connection established":
+            ("no connection established",
+             '{"description":"No connection established before '
+             'deadline"}'),
+        "name resolution":
+            ("name resolution failure",
+             '{"description":"Name resolution failed for target"}'),
+        "dns resolution failed":
+            ("dns resolution failed",
+             '{"description":"DNS resolution failed for host"}'),
+        "endpoints failed":
+            ("empty address list: all endpoints failed",
+             '{"description":"All endpoints failed to connect"}'),
+    }
+    assert set(wordings) == set(_UNSENT_MARKERS)
+    for marker, (details, debug) in wordings.items():
+        # details() alone carries the wording.
+        assert provably_unsent(FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, details=details
+        )), marker
+        # debug_error_string() alone carries it.
+        assert provably_unsent(FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, details="unavailable",
+            debug=debug,
+        )), marker
+        # Case-insensitive on either field.
+        assert provably_unsent(FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, details=details.upper()
+        )), marker
+        # The marker text under a NON-UNAVAILABLE code never classifies
+        # (a DEADLINE_EXCEEDED whose debug text mentions the original
+        # dial is still a mid-RPC failure).
+        assert not provably_unsent(FakeRpcError(
+            grpc.StatusCode.DEADLINE_EXCEEDED, details=details,
+            debug=debug,
+        )), marker
+        # The ever_connected() structural short-circuit makes the
+        # wording irrelevant in BOTH directions: never-connected
+        # classifies without it; ever-connected still classifies by
+        # text fallback.
+        assert provably_unsent(
+            FakeRpcError(grpc.StatusCode.UNAVAILABLE, details="???"),
+            FakePeer(ever=False),
+        ), marker
+        assert provably_unsent(
+            FakeRpcError(grpc.StatusCode.UNAVAILABLE, details=details),
+            FakePeer(ever=True),
+        ), marker
+
+    # Mid-RPC wordings that must NEVER classify as unsent.
+    for details in (
+        "Socket closed",
+        "Connection reset by peer",
+        "Stream removed",
+        "GOAWAY received",
+        "keepalive watchdog timeout",
+        "Broken pipe",
+    ):
+        assert not provably_unsent(FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, details=details,
+            debug=f'{{"description":"{details}"}}',
+        )), details
+        # ...even on a never-failing field split.
+        assert not provably_unsent(FakeRpcError(
+            grpc.StatusCode.UNAVAILABLE, debug=details,
+        )), details
+
+    # A peer object without ever_connected (duck-typing) falls back to
+    # text; error fields that THROW are tolerated.
+    class ThrowingError(Exception):
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+        def details(self):
+            raise RuntimeError("details unavailable")
+
+        def debug_error_string(self):
+            return "connection refused"
+
+    assert provably_unsent(ThrowingError(), object())
+
+
 def test_batcher_cancel_fails_dequeued_waiters():
     """A cancellation while the batcher holds dequeued requests must fail
     their futures, not orphan the callers (ADVICE r2)."""
