@@ -52,7 +52,10 @@ class CircuitConfig:
     jittered exponential backoff (`base_backoff_s * 2^(streak-1)`,
     capped at `max_backoff_s`, ±`jitter`) the breaker goes half-open
     and admits `half_open_probes` probe RPCs: one success re-closes it,
-    one failure re-opens with a doubled backoff."""
+    one failure re-opens with a doubled backoff.  A probe whose gated
+    RPC never reports an outcome (e.g. cancelled in flight) is treated
+    as failed `probe_timeout_s` after it was issued, so the breaker
+    cannot wedge half-open shedding forever."""
 
     enabled: bool = True
     failure_threshold: int = 5
@@ -60,6 +63,7 @@ class CircuitConfig:
     max_backoff_s: float = 30.0
     jitter: float = 0.2  # fraction of the backoff, uniform ±
     half_open_probes: int = 1
+    probe_timeout_s: float = 10.0
 
     def __post_init__(self) -> None:
         if self.failure_threshold < 1:
@@ -70,6 +74,11 @@ class CircuitConfig:
         if not 0.0 <= self.jitter <= 1.0:
             raise ValueError(
                 f"circuit jitter must be in [0, 1], got {self.jitter}"
+            )
+        if self.probe_timeout_s <= 0.0:
+            raise ValueError(
+                f"circuit probe_timeout_s must be > 0, "
+                f"got {self.probe_timeout_s}"
             )
 
 
@@ -482,6 +491,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             "GUBER_CIRCUIT_HALF_OPEN_PROBES",
             _env_int("GUBER_CIRCUIT_HALF_OPEN_PROBES", 1), 1,
         ),
+        probe_timeout_s=_env_float_s("GUBER_CIRCUIT_PROBE_TIMEOUT", 10.0),
     )
     shadow_fraction = float(_env("GUBER_DEGRADED_SHADOW_FRACTION", "0.5"))
     if not 0.0 < shadow_fraction <= 1.0:

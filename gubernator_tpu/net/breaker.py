@@ -24,7 +24,14 @@ This breaker turns a dead peer into a fast, bounded failure:
              admitted (`allow()` consumes a token; everything else
              still sheds).  One probe success re-closes the breaker and
              resets the backoff streak; one probe failure re-opens it
-             with the streak (and therefore the backoff) doubled.
+             with the streak (and therefore the backoff) doubled.  A
+             probe whose RPC never reports an outcome — e.g. the gated
+             call is torn down by CancelledError before the peer-client
+             error path can run — would otherwise wedge the breaker
+             half-open forever (tokens spent, nothing to return them);
+             `probe_timeout_s` after the last probe was issued with all
+             tokens spent and no outcome, the gates treat the probe as
+             failed and re-open with the backoff doubled.
 
 Threading/locks: breaker state is only ever touched from the daemon's
 single event loop (PeerClient call sites and the /metrics scrape both
@@ -80,6 +87,10 @@ class CircuitBreaker:
         self.opened_at = 0.0
         self.open_until = 0.0
         self._probes = 0  # half-open probe tokens consumed
+        # When the last half-open probe token was issued + the probe
+        # timeout: past this with all tokens spent and no recorded
+        # outcome, the probe is abandoned and the breaker re-opens.
+        self._probe_deadline = 0.0
 
     # -- schedule --------------------------------------------------------
     def backoff_s(self, streak: int) -> float:
@@ -132,11 +143,26 @@ class CircuitBreaker:
             self._probes = 0
             self._set_state(CircuitState.CLOSED)
 
+    def _expire_abandoned_probe(self) -> None:
+        """Half-open wedge guard: if every probe token was consumed but
+        no outcome ever landed (the gated RPC was cancelled, or its
+        error surfaced as something no caller records), re-open after
+        `probe_timeout_s` as if the probe had failed — the peer will be
+        re-probed after the (doubled) backoff instead of being shed
+        forever."""
+        if (
+            self.state is CircuitState.HALF_OPEN
+            and self._probes >= self.cfg.half_open_probes
+            and self._clock() >= self._probe_deadline
+        ):
+            self._open()
+
     # -- gates -----------------------------------------------------------
     def allow(self) -> bool:
         """Gate ONE RPC attempt; consumes a half-open probe token.
         Called at the point an RPC is actually issued (one batched send
         = one probe)."""
+        self._expire_abandoned_probe()
         if self.state is CircuitState.CLOSED:
             return True
         if self.state is CircuitState.OPEN:
@@ -146,11 +172,13 @@ class CircuitBreaker:
         if self._probes >= self.cfg.half_open_probes:
             return False
         self._probes += 1
+        self._probe_deadline = self._clock() + self.cfg.probe_timeout_s
         return True
 
     def would_allow(self) -> bool:
         """Non-consuming peek — the enqueue-time fast-fail gate.  True
         when an attempt reaching the RPC gate could be admitted."""
+        self._expire_abandoned_probe()
         if self.state is CircuitState.CLOSED:
             return True
         if self.state is CircuitState.OPEN:
@@ -161,6 +189,7 @@ class CircuitBreaker:
         """True while the breaker is open with backoff still running —
         the signal the degraded-mode fallback keys off (the owner is
         known-dead; retrying the ring would return the same peer)."""
+        self._expire_abandoned_probe()
         return (
             self.state is CircuitState.OPEN
             and self._clock() < self.open_until

@@ -387,14 +387,18 @@ class PeerClient:
             await self._connect()
             if self.breaker is not None and not self.breaker.allow():
                 raise self._shed("breaker_open")
-            budget = await self._ensure_ready()
-            if self.chaos is not None:
-                await self.chaos.on_client(
-                    self.peer_info.grpc_address, "GetPeerRateLimits"
+            try:
+                budget = await self._ensure_ready()
+                if self.chaos is not None:
+                    await self.chaos.on_client(
+                        self.peer_info.grpc_address, "GetPeerRateLimits"
+                    )
+                out = await self._raw_get_peer_rate_limits(
+                    payload, timeout=budget
                 )
-            out = await self._raw_get_peer_rate_limits(
-                payload, timeout=budget
-            )
+            except asyncio.CancelledError:
+                self._record_cancelled("GetPeerRateLimits[raw]")
+                raise
             self._record_success()
             return out
         except grpc.aio.AioRpcError as e:
@@ -419,15 +423,19 @@ class PeerClient:
             stub = await self._connect()
             if self.breaker is not None and not self.breaker.allow():
                 raise self._shed("breaker_open")
-            budget = await self._ensure_ready()
-            if self.chaos is not None:
-                await self.chaos.on_client(
-                    self.peer_info.grpc_address, "UpdatePeerGlobals"
+            try:
+                budget = await self._ensure_ready()
+                if self.chaos is not None:
+                    await self.chaos.on_client(
+                        self.peer_info.grpc_address, "UpdatePeerGlobals"
+                    )
+                req = peers_pb2.UpdatePeerGlobalsReq(
+                    globals=[grpc_api.global_to_pb(g) for g in globals_]
                 )
-            req = peers_pb2.UpdatePeerGlobalsReq(
-                globals=[grpc_api.global_to_pb(g) for g in globals_]
-            )
-            await stub.UpdatePeerGlobals(req, timeout=budget)
+                await stub.UpdatePeerGlobals(req, timeout=budget)
+            except asyncio.CancelledError:
+                self._record_cancelled("UpdatePeerGlobals")
+                raise
             self._record_success()
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
@@ -593,6 +601,21 @@ class PeerClient:
                 if not fut.done():
                     fut.set_exception(err)
 
+    def _record_cancelled(self, method: str) -> None:
+        """A breaker-gated RPC torn down by CancelledError — the outer
+        `asyncio.wait_for` on the GLOBAL flush/broadcast paths firing
+        before the gRPC deadline against a hung peer, or a cancelled
+        NO_BATCHING forward.  Must be recorded like any other failure:
+        it is real evidence the peer is not answering (the health window
+        and breaker would otherwise never see a black-holed peer from
+        GLOBAL-plane traffic), and the record returns the half-open
+        probe the attempt consumed (a swallowed outcome would wedge the
+        breaker HALF_OPEN with its probe budget spent forever)."""
+        self._record_error(
+            f"{method} to {self.peer_info.grpc_address} cancelled in "
+            "flight (caller deadline or teardown)"
+        )
+
     async def _call_get_peer_rate_limits(
         self, reqs: List[RateLimitReq]
     ) -> List[RateLimitResp]:
@@ -601,14 +624,18 @@ class PeerClient:
             # The RPC-issue gate: one batched send is one half-open
             # probe; anything past the probe budget sheds here.
             raise self._shed("breaker_open")
-        budget = await self._ensure_ready()
-        if self.chaos is not None:
-            await self.chaos.on_client(
-                self.peer_info.grpc_address, "GetPeerRateLimits"
+        try:
+            budget = await self._ensure_ready()
+            if self.chaos is not None:
+                await self.chaos.on_client(
+                    self.peer_info.grpc_address, "GetPeerRateLimits"
+                )
+            pb_req = peers_pb2.GetPeerRateLimitsReq(
+                requests=[grpc_api.req_to_pb(r) for r in reqs]
             )
-        pb_req = peers_pb2.GetPeerRateLimitsReq(
-            requests=[grpc_api.req_to_pb(r) for r in reqs]
-        )
-        pb_resp = await stub.GetPeerRateLimits(pb_req, timeout=budget)
+            pb_resp = await stub.GetPeerRateLimits(pb_req, timeout=budget)
+        except asyncio.CancelledError:
+            self._record_cancelled("GetPeerRateLimits")
+            raise
         self._record_success()
         return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

@@ -43,6 +43,7 @@ import numpy as np
 
 from gubernator_tpu.core import clock as clock_mod
 from gubernator_tpu.core.config import Config, MAX_BATCH_SIZE
+from gubernator_tpu.core.interval import GregorianError, gregorian_expiration
 from gubernator_tpu.core.types import (
     Behavior,
     HealthCheckResp,
@@ -708,7 +709,19 @@ class Service:
         if fr is not None:
             fr.record("degraded", mode=mode, key=key, owner=owner)
         now_ms = int(self.clock.now_ns() // 1_000_000)
-        reset_ms = now_ms + max(int(req.duration), 0)
+        if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
+            # req.duration is a calendar-interval id (0-5), NOT
+            # milliseconds — resolve it through the same expansion the
+            # algorithm layer uses, or omit reset_time when the id is
+            # invalid (the authoritative path would error on it anyway).
+            try:
+                reset_ms = gregorian_expiration(
+                    self.clock.now(), int(req.duration)
+                )
+            except GregorianError:
+                reset_ms = 0
+        else:
+            reset_ms = now_ms + max(int(req.duration), 0)
         if mode == "fail_closed":
             return RateLimitResp(
                 status=Status.OVER_LIMIT,
@@ -726,6 +739,17 @@ class Service:
                 metadata={"degraded": mode, "owner": owner},
             )
         # local_shadow
+        if req.limit <= 0:
+            # A deny-all key must stay deny-all while degraded: the
+            # max(1, ...) floor below exists to keep a small positive
+            # limit serviceable, not to fail-open an explicit zero.
+            return RateLimitResp(
+                status=Status.OVER_LIMIT,
+                limit=req.limit,
+                remaining=0,
+                reset_time=reset_ms,
+                metadata={"degraded": mode, "owner": owner},
+            )
         from dataclasses import replace as dc_replace
 
         shadow_limit = max(1, int(req.limit * self.cfg.shadow_fraction))

@@ -126,6 +126,45 @@ def test_breaker_opens_half_opens_recloses_on_schedule():
     ]
 
 
+def test_breaker_abandoned_probe_expires_and_reprobes():
+    """Regression: a half-open probe whose gated RPC never reports an
+    outcome (e.g. torn down by CancelledError) must not wedge the
+    breaker HALF_OPEN forever — probe_timeout_s after issue the probe
+    counts as failed, the breaker re-opens with the backoff doubled,
+    and the peer is probed again."""
+    t = [0.0]
+    b = CircuitBreaker(
+        CircuitConfig(
+            failure_threshold=1, base_backoff_s=0.5, max_backoff_s=4.0,
+            jitter=0.0, half_open_probes=1, probe_timeout_s=5.0,
+        ),
+        clock=lambda: t[0],
+        rng=random.Random(SEED),
+    )
+    b.record_failure()
+    assert b.state is CircuitState.OPEN
+    t[0] = 0.6
+    assert b.allow()  # the probe token is consumed...
+    assert b.state is CircuitState.HALF_OPEN
+    # ...and its outcome never lands.  Before the probe timeout the
+    # breaker sheds (probe budget spent), but does NOT shed forever:
+    t[0] = 5.5
+    assert not b.would_allow() and not b.allow()
+    assert b.state is CircuitState.HALF_OPEN
+    # Past the timeout the abandoned probe counts as a failure: the
+    # breaker re-opens (trip counted, backoff doubled to 1.0s)...
+    t[0] = 5.7
+    assert not b.would_allow()
+    assert b.state is CircuitState.OPEN and b.trips == 2
+    assert b.open_until - b.opened_at == pytest.approx(1.0)
+    assert b.fast_fail()  # degraded mode sees the re-open too
+    # ...and after the backoff a fresh probe is admitted and can close.
+    t[0] = b.open_until + 0.01
+    assert b.allow()
+    b.record_success()
+    assert b.state is CircuitState.CLOSED
+
+
 def test_breaker_backoff_caps_and_jitters():
     t = [0.0]
     cfg = CircuitConfig(
@@ -256,6 +295,92 @@ def test_degraded_fail_modes_shape():
     resp, svc = asyncio.run(scenario("error"))
     assert "not connected" in resp.error
     assert "degraded" not in (resp.metadata or {})
+
+
+def test_degraded_local_shadow_zero_limit_stays_deny_all():
+    """Regression: a limit=0 (deny-all) key must not admit 1 hit per
+    window from the shadow slot's max(1, ...) floor while degraded —
+    it answers OVER_LIMIT directly and writes no shadow state."""
+    async def scenario():
+        svc = Service(Config(
+            device=DeviceConfig(num_slots=1024, ways=8, batch_size=64),
+            degraded_mode="local_shadow",
+        ))
+        try:
+            peer = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"))
+            req = RateLimitReq(
+                name="deg", unique_key="deny", hits=1, limit=0,
+                duration=DURATION,
+            )
+            resp = await svc._degraded_response(
+                req, req.hash_key(), peer, PeerNotReadyError("gone")
+            )
+            await peer.shutdown()
+            assert resp.status == Status.OVER_LIMIT
+            assert resp.remaining == 0 and resp.limit == 0
+            assert resp.error == ""
+            assert resp.metadata["degraded"] == "local_shadow"
+            # No shadow slot was created for the deny-all key.
+            assert not svc._shadow
+            assert svc.backend.get_cache_item(
+                req.hash_key() + SHADOW_SUFFIX
+            ) is None
+        finally:
+            await svc.close()
+
+    asyncio.run(scenario())
+
+
+def test_degraded_reset_time_resolves_gregorian_durations():
+    """Regression: fail_open/fail_closed degraded answers must not
+    treat a Gregorian interval id (duration 0-5) as milliseconds —
+    reset_time is the end of the current calendar interval, or omitted
+    when the id is invalid."""
+    from gubernator_tpu.core import clock as clock_mod
+    from gubernator_tpu.core.interval import (
+        GREGORIAN_HOURS,
+        gregorian_expiration,
+    )
+
+    async def scenario(duration):
+        clk = clock_mod.Clock()
+        clk.freeze()
+        svc = Service(
+            Config(
+                device=DeviceConfig(num_slots=1024, ways=8, batch_size=64),
+                degraded_mode="fail_closed",
+            ),
+            clock=clk,
+        )
+        try:
+            peer = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"))
+            req = RateLimitReq(
+                name="deg", unique_key="greg", hits=1, limit=10,
+                duration=duration,
+                behavior=Behavior.DURATION_IS_GREGORIAN,
+            )
+            resp = await svc._degraded_response(
+                req, req.hash_key(), peer, PeerNotReadyError("gone")
+            )
+            await peer.shutdown()
+            expected = (
+                gregorian_expiration(clk.now(), duration)
+                if duration <= 5 else 0
+            )
+            return resp, expected
+        finally:
+            await svc.close()
+            clk.unfreeze()
+
+    resp, expected = asyncio.run(scenario(GREGORIAN_HOURS))
+    assert resp.reset_time == expected
+    # The end of the current hour, not the broken now + interval-id
+    # arithmetic (now + 1ms for GREGORIAN_HOURS).
+    assert expected > 1_000_000_000_000  # a real epoch-ms timestamp
+    # Invalid Gregorian id: reset_time omitted, not garbage.
+    resp, _ = asyncio.run(scenario(99))
+    assert resp.reset_time == 0
+    assert resp.status == Status.OVER_LIMIT
 
 
 # ---------------------------------------------------------------------

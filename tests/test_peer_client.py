@@ -371,6 +371,99 @@ def test_peer_shed_counter_distinguishes_reasons():
     run(scenario())
 
 
+def test_cancelled_rpc_records_error_and_feeds_breaker():
+    """Regression: a breaker-gated RPC torn down by CancelledError (an
+    outer asyncio.wait_for firing before the gRPC deadline, as on the
+    GLOBAL flush/broadcast paths against a hung peer) must be recorded
+    — it feeds the health window and breaker, and returns the half-open
+    probe the attempt consumed instead of wedging the breaker."""
+    from gubernator_tpu.core.config import CircuitConfig
+
+    class HangingChaos:
+        """Parks the RPC at the pre-send chaos hook forever — a
+        black-holed peer from the caller's point of view."""
+
+        async def on_client(self, dst, method):
+            await asyncio.Event().wait()
+
+    async def scenario():
+        pc = PeerClient(
+            PeerInfo(grpc_address="127.0.0.1:1"),
+            circuit=CircuitConfig(failure_threshold=2),
+            chaos=HangingChaos(),
+        )
+        pc._ever_ready = True  # skip the pre-dial readiness gate
+        req = RateLimitReq(
+            name="cancel", unique_key="k", hits=1, limit=5, duration=1000
+        )
+        # The GLOBAL flush shape: outer timer beats the RPC deadline.
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                pc.get_peer_rate_limits_batch([req]), timeout=0.05
+            )
+        errors = pc.last_errors()
+        assert len(errors) == 1 and "cancelled in flight" in errors[0]
+        assert pc.breaker.consecutive_failures == 1
+        # A second cancelled attempt trips the threshold-2 breaker —
+        # GLOBAL-plane traffic alone CAN open it against a hung peer.
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                pc.get_peer_rate_limits_batch([req]), timeout=0.05
+            )
+        assert pc.circuit_state_name() == "open"
+        # The broadcast path records too.
+        pc.breaker.record_success()  # re-close to pass the gate
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(pc.update_peer_globals([]), timeout=0.05)
+        assert len(pc.last_errors()) == 3
+        assert "UpdatePeerGlobals" in pc.last_errors()[-1]
+        await pc.shutdown()
+
+    run(scenario())
+
+
+def test_cancelled_half_open_probe_reopens_instead_of_wedging():
+    """Regression: with half_open_probes=1, a cancelled probe RPC used
+    to leave the breaker HALF_OPEN with its probe budget spent forever
+    (every request shed, the peer never probed again).  The recorded
+    cancellation now re-opens it, so the schedule keeps running."""
+    from gubernator_tpu.core.config import CircuitConfig
+
+    class HangingChaos:
+        async def on_client(self, dst, method):
+            await asyncio.Event().wait()
+
+    async def scenario():
+        pc = PeerClient(
+            PeerInfo(grpc_address="127.0.0.1:1"),
+            circuit=CircuitConfig(
+                failure_threshold=1, base_backoff_s=0.01,
+                max_backoff_s=0.02, jitter=0.0, half_open_probes=1,
+            ),
+            chaos=HangingChaos(),
+        )
+        pc._ever_ready = True
+        pc._record_error("injected failure")  # trip OPEN
+        assert pc.circuit_state_name() == "open"
+        await asyncio.sleep(0.02)  # backoff expires -> half-open window
+        req = RateLimitReq(
+            name="probe", unique_key="k", hits=1, limit=5, duration=1000
+        )
+        # The probe RPC is admitted (token consumed) then cancelled.
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                pc.get_peer_rate_limits_batch([req]), timeout=0.05
+            )
+        # Not wedged HALF_OPEN: the abandoned probe re-opened it, and
+        # after the (doubled, capped) backoff a fresh probe is allowed.
+        assert pc.circuit_state_name() == "open"
+        await asyncio.sleep(0.03)
+        assert pc.breaker.would_allow()
+        await pc.shutdown()
+
+    run(scenario())
+
+
 def test_provably_unsent_marker_table():
     """Satellite: table-driven coverage of the connect-phase marker
     wordings across grpc-core versions — each marker must classify
