@@ -102,7 +102,8 @@ def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
     ]).SerializeToString()
 
 
-def bench(seconds: float, concurrency: int) -> None:
+def bench(seconds: float, concurrency: int,
+          depth_sweep: Tuple[int, ...] = (1, 2, 4)) -> None:
     """Sync driver: client coroutines run on each cluster's OWN loop —
     grpc.aio multiplexes one poller per process, and a second event loop
     polling it (server on the cluster loop, clients on another) thrashes
@@ -122,17 +123,23 @@ def bench(seconds: float, concurrency: int) -> None:
         dev_cfg = DeviceConfig(num_slots=1 << 18, ways=8, batch_size=4096)
     else:
         dev_cfg = DeviceConfig(num_slots=1 << 22, ways=8, batch_size=4096)
-    # Honor the daemon's drain-policy env knob so A/B artifacts (shipped
-    # sparse=64 vs sparse=0) run the exact same harness (the real daemon
-    # reads it in setup_daemon_config; Cluster builds DaemonConfig
-    # directly, so mirror the one knob the A/B varies through the same
-    # parse/validate).  Cluster.start_with's `device=` argument is the
-    # single source of the device config — the template leaves it alone.
-    from gubernator_tpu.core.config import fastpath_sparse_from_env
+    # Honor the daemon's drain-policy env knobs so A/B artifacts (shipped
+    # sparse=64 vs sparse=0, pipeline depth 2 vs 1) run the exact same
+    # harness (the real daemon reads them in setup_daemon_config; Cluster
+    # builds DaemonConfig directly, so mirror the knobs the A/Bs vary
+    # through the same parse/validate).  Cluster.start_with's `device=`
+    # argument is the single source of the device config — the template
+    # leaves it alone.
+    from gubernator_tpu.core.config import (
+        fastpath_sparse_from_env,
+        pipeline_depth_from_env,
+    )
 
     sparse = fastpath_sparse_from_env()
+    depth = pipeline_depth_from_env()
 
     def conf(**kw) -> DaemonConfig:
+        kw.setdefault("pipeline_depth", depth)
         return DaemonConfig(fastpath_sparse=sparse, **kw)
 
     rng = np.random.default_rng(7)
@@ -422,10 +429,96 @@ def bench(seconds: float, concurrency: int) -> None:
             )
             budget["fastpath_served"] = fp.served
             budget["fastpath_fallbacks"] = fp.fallbacks
+        # Pipelined-drain stage split (docs/pipeline.md): cumulative
+        # dispatch vs fetch wall time over every machinery merge this
+        # daemon ran, normalized per 1000 served requests — the term the
+        # depth knob attacks is `fetch`, and `bubble` is the dispatch
+        # idle time a deeper pipeline would absorb.
+        mach = fp._mach
+        if fp.served:
+            per_k = fp.served / 1000.0
+            budget["pipeline_depth"] = fp.pipeline_depth
+            budget["dispatch_us_per_1000"] = round(
+                mach.dispatch_s * 1e6 / per_k
+            )
+            budget["fetch_us_per_1000"] = round(mach.fetch_s * 1e6 / per_k)
+            budget["bubble_us_per_1000"] = round(
+                mach.bubble_s * 1e6 / per_k
+            )
+            budget["drains"] = {
+                "total": mach.drains,
+                "overlap": mach.overlap_drains,
+                "waited": mach.waited_drains,
+                "max_inflight_seen": mach.max_inflight_seen,
+            }
         results.append(budget)
         print(json.dumps(budget), flush=True)
     finally:
         c.stop()
+
+    # ---- pipeline-depth sweep: the tentpole A/B ------------------------
+    # Re-run the two throughput configs (token_1k dense batches,
+    # leaky_1m Zipfian) and the small-batch latency config at each
+    # requested depth on fresh single-node daemons.  Depth 1 is the
+    # strict pre-pipeline discipline; the acceptance bar is depth-2
+    # checks_per_sec >= depth-1 where fetch dominates, with small-batch
+    # p50 no worse than the sparse-overlap numbers.
+    for d in depth_sweep:
+        try:
+            c = Cluster.start_with(
+                [""], device=dev_cfg,
+                conf_template=conf(pipeline_depth=d),
+            )
+            try:
+                addr = [c.daemons[0].grpc_address]
+                sweep_seconds = max(2.0, seconds / 2)
+                pays = [build_payload(
+                    [("bench_token", f"k{i}") for i in range(1000)]
+                )]
+                zipf_pays = []
+                for _ in range(32):
+                    ks = rng.zipf(1.3, size=1000) % 1_000_000
+                    zipf_pays.append(build_payload(
+                        [("bench_leaky", f"z{k}") for k in ks],
+                        algorithm=1, limit=1_000_000, duration=60_000,
+                    ))
+                small = [build_payload(
+                    [("bench_lat", f"l{j}") for j in range(10)]
+                )]
+                for name, pl, batch, cc in (
+                    ("token_1k_batch1000", pays, 1000, concurrency),
+                    ("leaky_1m_zipfian", zipf_pays, 1000, concurrency),
+                    ("latency_small_batch", small, 10, 4),
+                ):
+                    c.run(drive(addr, pl, 0.5, cc), timeout=120)  # warm
+                    t0 = time.perf_counter()
+                    rpcs, lat = c.run(
+                        drive(addr, pl, sweep_seconds, cc), timeout=120
+                    )
+                    emit(f"pipeline_sweep_{name}", rpcs * batch, rpcs,
+                         lat, time.perf_counter() - t0,
+                         {"pipeline_depth": d, "concurrency": cc})
+                fp = c.daemons[0].fastpath
+                mach = fp._mach
+                line = {
+                    "config": "pipeline_sweep_stages",
+                    "pipeline_depth": d,
+                    "dispatch_s": round(mach.dispatch_s, 3),
+                    "fetch_s": round(mach.fetch_s, 3),
+                    "bubble_s": round(mach.bubble_s, 3),
+                    "drains": mach.drains,
+                    "waited_drains": mach.waited_drains,
+                    "max_inflight_seen": mach.max_inflight_seen,
+                }
+                results.append(line)
+                print(json.dumps(line), flush=True)
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate sweep failures
+            print(json.dumps({
+                "config": "pipeline_sweep", "pipeline_depth": d,
+                "error": str(e),
+            }))
 
     # ---- config 2b: token bucket with a Store attached ----------------
     # The persistence SPI rides the fast lane (r4): each drain adds one
@@ -590,6 +683,8 @@ def bench(seconds: float, concurrency: int) -> None:
         "config": "summary",
         "platform": platform,
         "fastpath_sparse": sparse,
+        "pipeline_depth": depth,
+        "pipeline_depth_sweep": list(depth_sweep),
         "device": {
             "num_slots": dev_cfg.num_slots,
             "batch_size": dev_cfg.batch_size,
@@ -604,8 +699,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument(
+        "--pipeline-depth", default="1,2,4",
+        help="comma-separated GUBER_PIPELINE_DEPTH sweep re-running the "
+        "throughput + small-batch configs per depth (empty disables)",
+    )
     args = ap.parse_args()
-    bench(args.seconds, args.concurrency)
+    sweep = tuple(
+        int(d) for d in args.pipeline_depth.split(",") if d.strip()
+    )
+    bench(args.seconds, args.concurrency, depth_sweep=sweep)
 
 
 if __name__ == "__main__":

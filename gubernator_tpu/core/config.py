@@ -268,6 +268,16 @@ class DaemonConfig:
     # the limit and keep the strict depth-1 maximal-merge discipline).
     # 0 disables.
     fastpath_sparse: int = 64
+    # Pipelined-drain depth (docs/pipeline.md): how many coalesced
+    # merges may be OUTSTANDING (dispatched, response not yet fetched)
+    # per fast-lane lane.  The dispatch stage stays serialized — this
+    # never splits a maximal merge — but merge N+1's device dispatch
+    # overlaps merge N's device->host readback, moving steady-state
+    # throughput from B/(dispatch+fetch) toward B/max(dispatch, fetch).
+    # 1 restores the strict pre-pipeline discipline (dispatch and fetch
+    # serialized end to end); raise past 2 only if pipeline-occupancy
+    # telemetry shows the depth saturated AND bubble time is nonzero.
+    pipeline_depth: int = 2
     # Flight recorder / SLO telemetry (runtime/flightrec.py).  Off by
     # default: the ring + sampler are cheap, but dumps write to disk and
     # operators should choose the directory.
@@ -421,6 +431,15 @@ def fastpath_sparse_from_env() -> int:
     )
 
 
+def pipeline_depth_from_env() -> int:
+    """The pipelined-drain depth knob, parsed/validated exactly as the
+    daemon does (same harness contract as fastpath_sparse_from_env)."""
+    return _require_min(
+        "GUBER_PIPELINE_DEPTH",
+        _env_int("GUBER_PIPELINE_DEPTH", 2), 1,
+    )
+
+
 def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     """Build a DaemonConfig from GUBER_* env vars (config.go:253-459)."""
     if config_file:
@@ -538,6 +557,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env_int("GUBER_FASTPATH_INFLIGHT", 1), 1,
         ),
         fastpath_sparse=fastpath_sparse_from_env(),
+        pipeline_depth=pipeline_depth_from_env(),
         flightrec=_env("GUBER_FLIGHTREC") in ("1", "true"),
         flightrec_dir=_env("GUBER_FLIGHTREC_DIR", "flightrec-dumps"),
         flightrec_ring=_require_min(

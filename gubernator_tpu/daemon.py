@@ -263,6 +263,7 @@ class Daemon:
             self.service,
             max_inflight=getattr(self.conf, "fastpath_inflight", 1),
             sparse_limit=getattr(self.conf, "fastpath_sparse", 64),
+            pipeline_depth=getattr(self.conf, "pipeline_depth", 2),
         )
 
         # gRPC server (daemon.go:101-126): both services on one listener.
@@ -551,9 +552,10 @@ class Daemon:
             }
         fp = self.fastpath
         if fp is not None:
-            out["fastpath"] = {
-                "fallbacks": getattr(fp, "fallbacks", 0),
-            }
+            # Per-lane drain/pipeline counters (drains, overlap_drains,
+            # waited_drains, bubble_ms_total, occupancy) — the knobs an
+            # operator reads when tuning GUBER_PIPELINE_DEPTH.
+            out["fastpath"] = fp.debug_vars()
         fr = self.flightrec
         if fr is not None:
             out["flightrec"] = {

@@ -324,10 +324,20 @@ def generate_auto_tls(
     import ipaddress
     import socket
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ModuleNotFoundError as e:
+        # AutoTLS is the only path that needs the extra; operators with
+        # real cert/key files never reach here.
+        raise RuntimeError(
+            "AutoTLS (self-signed / shared-CA certificate generation) "
+            "requires the optional 'cryptography' package: install "
+            "gubernator-tpu[tls], or configure GUBER_TLS_CERT/"
+            "GUBER_TLS_KEY with existing certificate files"
+        ) from e
 
     def make_key():
         return rsa.generate_private_key(public_exponent=65537, key_size=2048)

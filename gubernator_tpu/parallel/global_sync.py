@@ -449,7 +449,7 @@ class GlobalEngine:
             DeltaGrid(*[jax.device_put(a, self.b._bsharding) for a in grid])
             for grid in chunks
         ]
-        captured = None
+        cap_keys = cap_token = wt_seq = None
         # Lock order: auth (backend) before cache (self).
         with self.b._lock, self._lock:
             for sharded in staged:
@@ -458,21 +458,68 @@ class GlobalEngine:
                 )
             if self.b.store is not None:
                 # Post-sync auth rows -> Store.on_change (the write-through
-                # of algorithms.go:154-158, batch-granular at the sync tier;
-                # captured inside the lock, delivered in ticket order).
-                items = self.b._read_items_locked(list(pending.keys()))
-                captured = [
-                    (p.req, items[key])
-                    for key, p in pending.items() if key in items
-                ]
+                # of algorithms.go:154-158, batch-granular at the sync
+                # tier).  The row gathers are DISPATCHED inside the lock —
+                # pinned to the post-sync table version (jax arrays are
+                # immutable) — and FETCHED outside it, so concurrent
+                # checks block only for the sync steps, never the
+                # device->host readback (the pipelined-drain split,
+                # docs/pipeline.md).
+                from gubernator_tpu.core.hashing import key_hash64
+
+                cap_keys = list(pending.keys())
+                h64 = np.array(
+                    [np.uint64(key_hash64(k)) for k in cap_keys],
+                    dtype=np.uint64,
+                ).view(np.int64)
+                cap_token = self.b._gather_rows_dispatch(h64, int(now))
                 wt_seq = self.b._wt_ticket()
             self.syncs += 1
             self.sync_keys += len(pending)
-        if captured is not None:
-            self.b._deliver_write_through(captured, wt_seq)
+        if cap_keys is not None:
+            captured: list = []
+            try:
+                a, rf = self.b._gather_rows_finish(
+                    cap_token, len(cap_keys)
+                )
+                captured = self._captured_items(cap_keys, pending, a, rf)
+            finally:
+                # Redeem the ticket even if a fetch fails — an
+                # unredeemed ticket wedges every later delivery
+                # (PersistenceHost._deliver_write_through).
+                self.b._deliver_write_through(captured, wt_seq)
         if self.on_synced is not None:
             self.on_synced(pending)
         return len(pending)
+
+    def _captured_items(self, keys, pending, a, rf) -> list:
+        """(req, CacheItem) pairs from packed GATHER_ROW_FIELDS columns —
+        misses and KIND_CACHED_RESP rows are skipped exactly like
+        MeshBackend._read_items_locked."""
+        from gubernator_tpu.core.types import Algorithm, CacheItem, Status
+        from gubernator_tpu.ops.state import KIND_CACHED_RESP
+
+        out: list = []
+        for j, key in enumerate(keys):
+            if not a[0, j] or a[1, j] == KIND_CACHED_RESP:
+                continue
+            algo = Algorithm(int(a[2, j]))
+            remaining = (
+                float(rf[j]) if algo == Algorithm.LEAKY_BUCKET
+                else int(a[5, j])
+            )
+            out.append((pending[key].req, CacheItem(
+                key=key,
+                algorithm=algo,
+                expire_at=int(a[9, j]),
+                limit=int(a[3, j]),
+                duration=int(a[4, j]),
+                remaining=remaining,
+                created_at=int(a[6, j]),
+                status=Status(int(a[7, j])),
+                burst=int(a[8, j]),
+            )))
+        return out
 
     def _build_chunks(self, pending: Dict[str, _Pending], now_dt):
         """Pack pending deltas into [n, n, D] grids (chunked on overflow)."""

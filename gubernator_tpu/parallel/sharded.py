@@ -362,14 +362,25 @@ class MeshBackend(PersistenceHost):
         DeviceBatch rounds (the compiled fast lane, runtime/fastpath.py).
         No persistence hooks — the fast lane requires no attached Store.
         Returns [n, B]-shaped host response dicts per round."""
+        return self.step_rounds_begin(rounds, add_tally)()
+
+    def step_rounds_begin(self, rounds: Sequence, add_tally: bool = True):
+        """Pipelined step_rounds (see DeviceBackend.step_rounds_begin):
+        dispatch under the lock, return the host-fetch closure — the
+        sharded responses are pinned to this table version, so the fetch
+        may run while the next merge dispatches."""
         from gubernator_tpu.runtime.backend import tally_from_rounds
 
         with self._lock:
             round_resps = self._dispatch_rounds_locked(rounds)
-        host = packed_grid_rounds_to_host(round_resps)
-        if add_tally:
-            self._add_tally(tally_from_rounds(rounds, host))
-        return host
+
+        def fetch() -> List[Dict[str, np.ndarray]]:
+            host = packed_grid_rounds_to_host(round_resps)
+            if add_tally:
+                self._add_tally(tally_from_rounds(rounds, host))
+            return host
+
+        return fetch
 
     def _dispatch_rounds_locked(self, rounds) -> list:
         """Dispatch grid rounds; caller holds `_lock` (see

@@ -443,20 +443,36 @@ class DeviceBackend(PersistenceHost):
         with add_tally, tallies update vectorized (the fast lane passes
         False and counts per REQUEST — cascade occurrences share device
         lanes)."""
+        return self.step_rounds_begin(rounds, add_tally)()
+
+    def step_rounds_begin(
+        self, rounds: Sequence[DeviceBatch], add_tally: bool = True
+    ):
+        """Pipelined step_rounds: dispatch the rounds under the lock and
+        return a zero-arg fetch closure producing the host response
+        dicts.  The dispatched responses are this call's own output
+        buffers pinned to this table version (jax arrays are immutable),
+        so the caller may run the closure on a fetch stage while the
+        next merge dispatches — the two-stage drain discipline
+        (fastpath._Coalescer)."""
         t_start = time.monotonic()
         with self._lock:
             round_resps = self._dispatch_rounds_locked(rounds)
-        host = packed_rounds_to_host(round_resps)
-        if add_tally:
-            tally = tally_from_rounds(rounds, host)
-            self._add_tally(tally)
-            fr = getattr(self.metrics, "flightrec", None)
-            if fr is not None:
-                fr.record_batch(
-                    tally.checks, (time.monotonic() - t_start) * 1e3,
-                    over_limit=tally.over_limit,
-                )
-        return host
+
+        def fetch() -> List[Dict[str, np.ndarray]]:
+            host = packed_rounds_to_host(round_resps)
+            if add_tally:
+                tally = tally_from_rounds(rounds, host)
+                self._add_tally(tally)
+                fr = getattr(self.metrics, "flightrec", None)
+                if fr is not None:
+                    fr.record_batch(
+                        tally.checks, (time.monotonic() - t_start) * 1e3,
+                        over_limit=tally.over_limit,
+                    )
+            return host
+
+        return fetch
 
     def _dispatch_rounds_locked(self, rounds) -> list:
         """Dispatch pre-packed rounds; caller holds `_lock`.  Returns the

@@ -58,6 +58,7 @@ the semantic reference:
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -87,47 +88,125 @@ _TIER_SKETCH_FRAME = native.meta_frame(b"tier", b"sketch")
 
 
 class _Coalescer:
-    """The drain discipline shared by the machinery lane and the sketch
-    lane: while `max_inflight` merges are in flight, arrivals accumulate
-    in the queue; each drain takes the WHOLE queue as one merge (bigger
-    merges amortize the per-merge device round-trip).  `process` runs on
-    a pool thread with the drained entry list and returns one result per
-    entry, delivered through each entry's future.
+    """The drain discipline shared by the machinery, sketch, and engine
+    lanes: arrivals accumulate in the queue; each drain takes the WHOLE
+    queue as one merge (bigger merges amortize the per-merge device
+    round-trip).  `process` runs on a pool thread with the drained entry
+    list; results deliver through each entry's future.
 
-    Adaptive sparse overlap (`sparse_limit` > 0): a drain no bigger than
-    `sparse_limit` requests that would otherwise WAIT for the in-flight
-    merge's response sync may instead dispatch on one of OVERLAP_SLOTS
-    overlap slots — at low load an arrival then costs ~1 device
-    round-trip instead of ~2.  Re-A/B'd interleaved on the r5 rig:
-    small-batch p50 156 -> 86ms in both reps, token throughput within
-    run-to-run noise (52.0k vs 52.0k, 46.4k vs 51.6k checks/s).  One
-    slot was NOT enough — concurrent small arrivals need a slot each to
-    all dispatch within the current fetch cycle (the r4 artifact's
-    "no win" note was measured with a single slot); the reference's
-    batcher fires its window early when sparse, peer_client.go:373-446.
-    Under load drains exceed the limit and the strict depth-1
-    maximal-merge discipline holds (measured monotone 1>2>3>4>6 for big
-    merges — splitting them costs throughput)."""
+    Two-stage pipeline (the r5 E2E artifact showed the device->host
+    response fetch dominating the merge cycle while the old discipline
+    serialized it behind the next merge's dispatch):
+
+      dispatch stage — serialized (`max_inflight`, default 1).  `process`
+        packs and dispatches the device step (holding the backend lock)
+        and returns a zero-arg FETCH CONTINUATION instead of results.
+        The table-update chain already serializes correctly on the XLA
+        stream, so merge N+1 may dispatch the moment merge N's dispatch
+        returns.
+      fetch stage — depth-`pipeline_depth` (GUBER_PIPELINE_DEPTH).  The
+        continuation syncs the response to host and unmarshals; out-of-
+        order completion is safe because results flow through per-entry
+        futures.  A fetch SLOT is taken before dispatching, so at most
+        `pipeline_depth` merges are outstanding end-to-end; the time a
+        ready drain spends waiting for a slot is the pipeline's bubble
+        (tracked in `bubble_s` + the bubble metrics).
+
+    Steady-state throughput moves from B/(dispatch+fetch) toward
+    B/max(dispatch, fetch).  Maximal merges are preserved — this
+    pipelines ACROSS merges, it never splits one (the r5 A/B pinned
+    monotone 1>2>3>4>6 for splitting).  `process` may also return a
+    plain result list (single-phase; the fetch stage is then a no-op) —
+    tests and simple lanes use that form.
+
+    Adaptive sparse overlap (`sparse_limit` > 0) is the depth-k special
+    case of the same mechanism: a drain no bigger than `sparse_limit`
+    requests that finds every base fetch slot busy may take one of
+    OVERLAP_SLOTS sparse fetch slots instead of waiting — at low load a
+    small arrival then costs ~1 device round-trip even when the pipeline
+    is full (r5: small-batch p50 156 -> 86ms; the reference's batcher
+    fires its window early when sparse, peer_client.go:373-446).  Under
+    load drains exceed the limit and the maximal-merge discipline holds.
+    """
 
     OVERLAP_SLOTS = 3
 
     def __init__(self, pool, process, max_inflight: int = 1,
-                 sparse_limit: int = 0, size_of=None) -> None:
+                 sparse_limit: int = 0, size_of=None,
+                 pipeline_depth: int = 1, metrics=None,
+                 lane: str = "") -> None:
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
         self._pool = pool
         self._process = process
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
-        self._inflight = asyncio.Semaphore(max_inflight)
+        self._dispatch_sem = asyncio.Semaphore(max_inflight)
+        self._fetch = asyncio.Semaphore(pipeline_depth)
         self._overlap = asyncio.Semaphore(self.OVERLAP_SLOTS)
         self._sparse_limit = sparse_limit
         self._size_of = size_of or (lambda e: 1)
         self._dispatches: set = set()
         self._closed = False
-        # Observability: total drains / drains that rode the overlap slot
-        # / drains that had to wait for the in-flight merge's slot.
+        self.pipeline_depth = pipeline_depth
+        self._metrics = metrics
+        self._lane = lane
+        # Observability: total drains / drains that rode a sparse fetch
+        # slot / drains that had to wait for a fetch slot (each wait is
+        # one pipeline bubble; bubble_s accumulates the idle time).
         self.drains = 0
         self.overlap_drains = 0
         self.waited_drains = 0
+        self.bubble_s = 0.0
+        # Cumulative stage wall time (the bench artifact's dispatch vs
+        # fetch budget split; mirrors fastpath_stage_duration sums).
+        self.dispatch_s = 0.0
+        self.fetch_s = 0.0
+        # Merges currently in flight (dispatch or fetch stage) and the
+        # peak ever observed — the pipeline-occupancy view.
+        self.inflight = 0
+        self.max_inflight_seen = 0
+
+    def debug_vars(self) -> dict:
+        """The /debug/vars view of this lane's drain discipline."""
+        return {
+            "drains": self.drains,
+            "overlap_drains": self.overlap_drains,
+            "waited_drains": self.waited_drains,
+            "bubble_ms_total": round(self.bubble_s * 1e3, 3),
+            "dispatch_ms_total": round(self.dispatch_s * 1e3, 3),
+            "fetch_ms_total": round(self.fetch_s * 1e3, 3),
+            "inflight": self.inflight,
+            "max_inflight_seen": self.max_inflight_seen,
+            "pipeline_depth": self.pipeline_depth,
+        }
+
+    def _count_drain(self, kind: str) -> None:
+        m = self._metrics
+        if m is not None:
+            m.fastpath_drains.labels(lane=self._lane, kind=kind).inc()
+
+    def _note_stage(self, stage: str, dt_s: float) -> None:
+        if stage == "dispatch":
+            self.dispatch_s += dt_s
+        else:
+            self.fetch_s += dt_s
+        m = self._metrics
+        if m is not None:
+            m.fastpath_stage_duration.labels(
+                lane=self._lane, stage=stage
+            ).observe(dt_s)
+
+    def _note_bubble(self, dt_s: float) -> None:
+        self.bubble_s += dt_s
+        m = self._metrics
+        if m is not None:
+            m.fastpath_bubble_seconds.labels(lane=self._lane).inc(dt_s)
+            fr = getattr(m, "flightrec", None)
+            if fr is not None:
+                fr.record_bubble(self._lane, dt_s * 1e3)
 
     async def do(self, entry):
         """Submit an entry and await its result."""
@@ -146,6 +225,35 @@ class _Coalescer:
             except asyncio.QueueEmpty:
                 return
 
+    async def _acquire_fetch_slot(self, entries: list):
+        """Take a fetch slot for one merge BEFORE its dispatch (bounds
+        outstanding merges to pipeline_depth + sparse slots).  Returns
+        the semaphore to release when the merge's fetch completes."""
+        if not self._fetch.locked():
+            await self._fetch.acquire()  # immediate
+            return self._fetch
+        if (
+            self._sparse_limit > 0
+            and not self._overlap.locked()
+            and sum(self._size_of(e) for e in entries)
+            <= self._sparse_limit
+        ):
+            # Sparse drain while the pipeline is full: overlap on a
+            # sparse slot instead of waiting out a fetch.
+            await self._overlap.acquire()
+            self.overlap_drains += 1
+            self._count_drain("overlap")
+            return self._overlap
+        # Loaded: hold for a slot (the pipeline bubble); arrivals keep
+        # accumulating and ship as ONE bigger merge.
+        self.waited_drains += 1
+        self._count_drain("waited")
+        t0 = time.monotonic()
+        await self._fetch.acquire()
+        self._note_bubble(time.monotonic() - t0)
+        self._drain_into(entries)
+        return self._fetch
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
@@ -153,49 +261,98 @@ class _Coalescer:
             entries = [first]
             self._drain_into(entries)
             self.drains += 1
+            self._count_drain("total")
+            fetch_sem = None
             try:
-                if not self._inflight.locked():
-                    await self._inflight.acquire()  # immediate
-                    sem = self._inflight
-                elif (
-                    self._sparse_limit > 0
-                    and not self._overlap.locked()
-                    and sum(self._size_of(e) for e in entries)
-                    <= self._sparse_limit
-                ):
-                    # Sparse drain while a merge is in flight: overlap
-                    # instead of waiting out its response sync.
-                    await self._overlap.acquire()
-                    sem = self._overlap
-                    self.overlap_drains += 1
-                else:
-                    # Loaded: hold for the slot; arrivals keep
-                    # accumulating and ship as ONE bigger merge.
-                    self.waited_drains += 1
-                    await self._inflight.acquire()
-                    sem = self._inflight
+                fetch_sem = await self._acquire_fetch_slot(entries)
+                # Dispatch serialization: the previous merge's dispatch
+                # stage is short (no response sync), so this rarely
+                # blocks; any arrivals during a wait still merge in.
+                if self._dispatch_sem.locked():
+                    await self._dispatch_sem.acquire()
                     self._drain_into(entries)
+                else:
+                    await self._dispatch_sem.acquire()
             except asyncio.CancelledError:
                 # Shutdown while holding dequeued entries: fail them
                 # instead of orphaning their awaiting handlers.
+                if fetch_sem is not None:
+                    fetch_sem.release()
                 for en in entries:
                     if not en.fut.done():
                         en.fut.set_exception(
                             RuntimeError("fastpath closed")
                         )
                 raise
+            self.inflight += 1
+            if self.inflight > self.max_inflight_seen:
+                self.max_inflight_seen = self.inflight
+            m = self._metrics
+            if m is not None:
+                m.fastpath_pipeline_occupancy.labels(
+                    lane=self._lane
+                ).observe(self.inflight)
             task = asyncio.ensure_future(
-                self._dispatch(loop, entries, sem)
+                self._dispatch(loop, entries, fetch_sem)
             )
             self._dispatches.add(task)
             task.add_done_callback(self._dispatches.discard)
 
-    async def _dispatch(self, loop, entries, sem) -> None:
+    @staticmethod
+    def _once(fn):
+        """At-most-once wrapper for a fetch continuation: the normal
+        path and the orphan resubmit below may both submit it; only the
+        first execution runs the closure."""
+        ran = [False]
+        gate = threading.Lock()
+
+        def run_once():
+            with gate:
+                if ran[0]:
+                    return None
+                ran[0] = True
+            return fn()
+
+        return run_once
+
+    async def _dispatch(self, loop, entries, fetch_sem) -> None:
+        """One merge's pipeline: dispatch stage on a pool thread (holds
+        the dispatch slot), then — if `process` returned a continuation —
+        the fetch stage on another pool pass (holds only the fetch slot,
+        so the next merge dispatches concurrently)."""
+        fetch_fn = None
         try:
-            outs = await loop.run_in_executor(
-                self._pool, lambda: self._process(entries)
-            )
+            t0 = time.monotonic()
+            try:
+                res = await loop.run_in_executor(
+                    self._pool, lambda: self._process(entries)
+                )
+            finally:
+                # Dispatch stage over (or failed): the next merge may
+                # dispatch while this one fetches.
+                self._dispatch_sem.release()
+                self._note_stage("dispatch", time.monotonic() - t0)
+            if callable(res):
+                fetch_fn = self._once(res)
+                t0 = time.monotonic()
+                outs = await loop.run_in_executor(self._pool, fetch_fn)
+                self._note_stage("fetch", time.monotonic() - t0)
+            else:
+                outs = res  # single-phase process
         except BaseException as e:  # CancelledError is a BaseException
+            if fetch_fn is not None and isinstance(
+                e, asyncio.CancelledError
+            ):
+                # The dispatch stage already mutated device/store state
+                # (donated table step, write-through ticket); a fetch
+                # continuation that never runs would leak its ticket
+                # and wedge every later Store.on_change delivery in
+                # cond.wait.  Submit it straight to the pool — detached
+                # from this cancelled task; the at-most-once gate makes
+                # this a no-op when the awaited run already started.
+                # FastPath.close() joins the pool, so the side effects
+                # land before teardown.  The entries still fail below.
+                self._pool.submit(fetch_fn)
             err = (
                 RuntimeError("fastpath closed")
                 if isinstance(e, asyncio.CancelledError) else e
@@ -210,7 +367,8 @@ class _Coalescer:
                 if not en.fut.done():
                     en.fut.set_result(out)
         finally:
-            sem.release()
+            self.inflight -= 1
+            fetch_sem.release()
 
     async def close(self) -> None:
         self._closed = True  # new do() calls fail fast, never respawn _run
@@ -233,29 +391,40 @@ class _Coalescer:
 class FastPath:
     """Per-service compiled lane with a coalescing columnar batcher.
 
-    `max_inflight` bounds how many coalesced merges run at once.  The
-    default of 1 means every drain takes the WHOLE queue as one maximal
-    merge — measured 2x faster than depth 3 through a ~65ms-RTT device
-    tunnel (51k vs 24k checks/s, monotone across depths 1>2>3>4>6):
-    a step's cost is dominated by its synchronous response round-trip,
-    and FEWER, BIGGER merges amortize that better than overlapping
-    smaller ones.  Dispatch order is serialized by the backend lock;
-    cascade merges hold that lock across their whole read -> replay ->
-    write-back window, which serializes them against every other
-    mutation path (this lane, the object path, the GLOBAL managers)
-    exactly like any other single-writer section."""
+    `max_inflight` bounds concurrent DISPATCH stages (default 1: every
+    drain takes the WHOLE queue as one maximal merge — the r2 A/B pinned
+    monotone 1>2>3>4>6 throughput for splitting big merges, 51k vs 24k
+    checks/s through a ~65ms-RTT tunnel).  `pipeline_depth` bounds
+    OUTSTANDING merges (dispatched, response not yet fetched): the
+    response round-trip that used to serialize behind the next dispatch
+    now overlaps it, so maximal merges pipeline without ever being
+    split (docs/pipeline.md).  Dispatch order is serialized by the
+    backend lock; cascade merges hold that lock across their whole
+    read -> replay -> write-back window, which serializes them against
+    every other mutation path (this lane, the object path, the GLOBAL
+    managers) exactly like any other single-writer section."""
 
     def __init__(self, service, max_inflight: int = 1,
-                 sparse_limit: int = 64) -> None:
+                 sparse_limit: int = 64,
+                 pipeline_depth: int = 2) -> None:
         if max_inflight < 1:
             raise ValueError(
                 f"fastpath max_inflight must be >= 1, got {max_inflight}"
             )
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"fastpath pipeline_depth must be >= 1, "
+                f"got {pipeline_depth}"
+            )
         self.s = service
-        # Extra workers back the sparse-overlap slots, or their merges
-        # would queue behind the in-flight one in this very pool.
+        metrics = service.metrics
+        # Worker budget: one thread per concurrent dispatch stage plus
+        # one per outstanding fetch (pipeline depth + sparse overlap
+        # slots) — a fetch blocked on the device (or on a write-through
+        # ticket) must never starve the next merge's dispatch in this
+        # very pool.
         self._pool = ThreadPoolExecutor(
-            max_workers=max_inflight + (
+            max_workers=max_inflight + pipeline_depth + (
                 _Coalescer.OVERLAP_SLOTS if sparse_limit > 0 else 0
             ),
             thread_name_prefix="tpu-fastlane",
@@ -264,24 +433,34 @@ class FastPath:
             self._pool, self._process, max_inflight,
             sparse_limit=sparse_limit,
             size_of=lambda e: e.cols.n,
+            pipeline_depth=pipeline_depth,
+            metrics=metrics, lane="mach",
         )
         # The sketch and engine lanes each coalesce cross-RPC into one
         # maximal merge at a time, on DEDICATED workers so machinery
-        # syncs can't starve them (and vice versa).
+        # syncs can't starve them (and vice versa); each lane pipelines
+        # its own dispatch/fetch stages at the same depth.
         self._sketch_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tpu-fastlane-sketch"
+            max_workers=1 + pipeline_depth,
+            thread_name_prefix="tpu-fastlane-sketch",
         )
         self._sketch_lane = (
-            _Coalescer(self._sketch_pool, self._sketch_process)
+            _Coalescer(self._sketch_pool, self._sketch_process,
+                       pipeline_depth=pipeline_depth,
+                       metrics=metrics, lane="sketch")
             if service.sketch_backend is not None else None
         )
         self._engine_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tpu-fastlane-engine"
+            max_workers=1 + pipeline_depth,
+            thread_name_prefix="tpu-fastlane-engine",
         )
         self._engine_lane = (
-            _Coalescer(self._engine_pool, self._engine_process)
+            _Coalescer(self._engine_pool, self._engine_process,
+                       pipeline_depth=pipeline_depth,
+                       metrics=metrics, lane="engine")
             if service.global_engine is not None else None
         )
+        self.pipeline_depth = pipeline_depth
         # Servings since start (observability; also asserted in tests to
         # prove the fast lane actually ran).
         self.served = 0
@@ -289,6 +468,20 @@ class FastPath:
         self._owner_frames: Dict[bytes, bytes] = {}
         # (membership_version, combined hash array) — see _sketch_hashes.
         self._sk_hashes: Optional[Tuple[int, np.ndarray]] = None
+
+    def debug_vars(self) -> dict:
+        """The /debug/vars view: per-lane drain/pipeline counters."""
+        lanes = {"mach": self._mach.debug_vars()}
+        if self._sketch_lane is not None:
+            lanes["sketch"] = self._sketch_lane.debug_vars()
+        if self._engine_lane is not None:
+            lanes["engine"] = self._engine_lane.debug_vars()
+        return {
+            "served": self.served,
+            "fallbacks": self.fallbacks,
+            "pipeline_depth": self.pipeline_depth,
+            "lanes": lanes,
+        }
 
     # -- eligibility -----------------------------------------------------
     def _eligible(self) -> bool:
@@ -783,10 +976,13 @@ class FastPath:
         await asyncio.gather(*tasks)
         return status, out_lim, remaining, reset, stored, stored_st, cap_ok
 
-    def _engine_process(self, entries) -> List[Tuple[np.ndarray, ...]]:
+    def _engine_process(self, entries):
         """Merged columnar serving for node-owned GLOBAL lanes on the
         mesh GlobalEngine — one coalescer drain = ONE engine lock hold
         and dispatch chain (runs on the engine lane's worker thread).
+        Dispatch stage: aggregate + pack + serve_packed (engine lock);
+        the returned closure (host fetch, unmarshal, tally, deferred
+        sync) is the fetch stage.
 
         Per ENTRY, duplicates aggregate to one lane per unique key
         (hits summed, first occurrence's params, shared response) —
@@ -870,39 +1066,43 @@ class FastPath:
                     (req, int(hits_sum[j]), int(sh[off + j]))
                 )
         resps, want_sync = engine.serve_packed(rounds, pend)
-        host = packed_grid_rounds_to_host(resps)
 
-        mt = len(h_all)
-        st_u = np.zeros(mt, dtype=np.int64)
-        lm_u = np.zeros(mt, dtype=np.int64)
-        rem_u = np.zeros(mt, dtype=np.int64)
-        rst_u = np.zeros(mt, dtype=np.int64)
-        for r_idx in range(n_rounds):
-            sel = order[bounds[r_idx]:bounds[r_idx + 1]]
-            hr = host[r_idx]
-            at = (sh[sel], lane[sel])
-            st_u[sel] = hr["status"][at]
-            lm_u[sel] = hr["limit"][at]
-            rem_u[sel] = hr["remaining"][at]
-            rst_u[sel] = hr["reset_time"][at]
+        def fetch() -> List[Tuple[np.ndarray, ...]]:
+            host = packed_grid_rounds_to_host(resps)
 
-        t = tally_from_rounds(rounds, host)
-        self.s.backend._add_tally(Tally(
-            checks=mt,
-            over_limit=int((st_u == 1).sum()),
-            not_persisted=t.not_persisted,
-            cache_hits=t.cache_hits,
-        ))
-        if want_sync:
-            engine.sync()
-        outs: List[Tuple[np.ndarray, ...]] = []
-        for i, (_e, _uniq, inv, _rep, m, _hits, _burst) in enumerate(per):
-            lo, hi = int(offs[i]), int(offs[i + 1])
-            outs.append((
-                st_u[lo:hi][inv], lm_u[lo:hi][inv],
-                rem_u[lo:hi][inv], rst_u[lo:hi][inv],
+            mt = len(h_all)
+            st_u = np.zeros(mt, dtype=np.int64)
+            lm_u = np.zeros(mt, dtype=np.int64)
+            rem_u = np.zeros(mt, dtype=np.int64)
+            rst_u = np.zeros(mt, dtype=np.int64)
+            for r_idx in range(n_rounds):
+                sel = order[bounds[r_idx]:bounds[r_idx + 1]]
+                hr = host[r_idx]
+                at = (sh[sel], lane[sel])
+                st_u[sel] = hr["status"][at]
+                lm_u[sel] = hr["limit"][at]
+                rem_u[sel] = hr["remaining"][at]
+                rst_u[sel] = hr["reset_time"][at]
+
+            t = tally_from_rounds(rounds, host)
+            self.s.backend._add_tally(Tally(
+                checks=mt,
+                over_limit=int((st_u == 1).sum()),
+                not_persisted=t.not_persisted,
+                cache_hits=t.cache_hits,
             ))
-        return outs
+            if want_sync:
+                engine.sync()
+            outs: List[Tuple[np.ndarray, ...]] = []
+            for i, (_e, _uq, inv, _rep, _m, _hits, _bst) in enumerate(per):
+                lo, hi = int(offs[i]), int(offs[i + 1])
+                outs.append((
+                    st_u[lo:hi][inv], lm_u[lo:hi][inv],
+                    rem_u[lo:hi][inv], rst_u[lo:hi][inv],
+                ))
+            return outs
+
+        return fetch
 
     @staticmethod
     def _sketch_meta(n: int, sk) -> Tuple[Optional[bytes],
@@ -1430,34 +1630,40 @@ class FastPath:
         return out
 
     # -- merge processing (runs on _pool threads via _Coalescer) ---------
-    def _sketch_process(
-        self, entries: Sequence["_SketchEntry"]
-    ) -> List[Tuple[np.ndarray, ...]]:
+    def _sketch_process(self, entries: Sequence["_SketchEntry"]):
         """One CMS dispatch for a drained sketch-entry list (cross-RPC
         coalescing; duplicate keys landing in one device chunk share its
         pre-chunk estimate — the CMS's documented batch-granularity
-        approximation)."""
+        approximation).  Dispatch stage: concat + device dispatch under
+        the sketch lock; the returned closure is the fetch stage."""
         if len(entries) == 1:
             kh, hh, ll = entries[0].kh, entries[0].hits, entries[0].limits
         else:
             kh = np.concatenate([e.kh for e in entries])
             hh = np.concatenate([e.hits for e in entries])
             ll = np.concatenate([e.limits for e in entries])
-        st, rem, rst = self.s.sketch_backend.check_cols(kh, hh, ll)
-        outs: List[Tuple[np.ndarray, ...]] = []
-        off = 0
-        for e in entries:
-            k = len(e.kh)
-            outs.append((st[off:off + k], rem[off:off + k],
-                         rst[off:off + k]))
-            off += k
-        return outs
+        fetch_cols = self.s.sketch_backend.check_cols_begin(kh, hh, ll)
 
-    def _process(
-        self, entries: Sequence["_Entry"]
-    ) -> List[Tuple[np.ndarray, ...]]:
-        """Pack -> step -> gather for a coalesced entry list (runs on a
-        fast-lane pool thread; everything here is numpy/C++/device).
+        def fetch() -> List[Tuple[np.ndarray, ...]]:
+            st, rem, rst = fetch_cols()
+            outs: List[Tuple[np.ndarray, ...]] = []
+            off = 0
+            for e in entries:
+                k = len(e.kh)
+                outs.append((st[off:off + k], rem[off:off + k],
+                             rst[off:off + k]))
+                off += k
+            return outs
+
+        return fetch
+
+    def _process(self, entries: Sequence["_Entry"]):
+        """Pack -> step for a coalesced entry list (runs on a fast-lane
+        pool thread; everything here is numpy/C++/device).  This is the
+        DISPATCH stage of the pipelined drain: it returns a zero-arg
+        fetch closure (host sync + gather + persistence delivery) that
+        the coalescer runs on its fetch stage, so the next merge's
+        dispatch overlaps this merge's device->host readback.
 
         Duplicate-heavy batches (Zipfian hot keys) would otherwise explode
         into one device round PER OCCURRENCE of the hottest key; eligible
@@ -1496,11 +1702,7 @@ class FastPath:
         plan = _plan_cascade(h, hits, reset_remaining, is_greg,
                              lim, dur, algo, burst, use_cached)
 
-        from gubernator_tpu.runtime.backend import (
-            Tally,
-            packed_rounds_to_host,
-            tally_from_rounds,
-        )
+        from gubernator_tpu.runtime.backend import packed_rounds_to_host
 
         backend = self.s.backend
         store = backend.store
@@ -1578,112 +1780,144 @@ class FastPath:
                 persv[sel] = hr["persisted"][idx]
 
         t_step0 = time.monotonic()
-        if plan is None and not do_store:
-            # Plain merge: dispatch under the backend lock, sync outside
-            # — arrivals keep accumulating into the NEXT maximal merge
-            # while this one's response syncs (and at fastpath_inflight
-            # > 1, merges overlap their round-trips).
-            host = backend.step_rounds(rounds, add_tally=False)
-            gather(host)
-        else:
-            # Cascade merge: the read -> host replay -> write-back window
-            # must not interleave with ANY other step on these keys — from
-            # this lane, the object path, or the GLOBAL managers — so the
-            # whole window runs under the backend lock (the same
-            # single-writer discipline as every other mutation path).  The
-            # write-back itself needs no response sync: the replay already
-            # produced every response, and dispatch order serializes it.
-            #
-            # Store drains take this branch too, with NO pre-step
-            # residency probe: the step itself answers residency through
-            # its `found` column, so a warm drain pays ONE combined
-            # response+capture fetch — storeless parity — instead of the
-            # probe fetch + combined fetch it used to (algorithms.go:45-51
-            # consults the store only on cache miss; misses repair below).
-            # The lock is held through the fetch: a cold key was served
-            # from a FRESH row that the repair replaces, and no other
-            # drain may observe the interim state.
-            cap_token = wt_seq = None
-            with backend._lock:
-                resps = backend._dispatch_rounds_locked(rounds)
-                if plan is not None:
-                    host = to_host(resps)
-                    gather(host)
-                    wb = _run_cascade(
-                        plan, h, hits, lim, dur, algo, burst,
-                        status, out_lim, remaining, reset, stored, cachedv,
-                        stored_st,
-                    )
-                    if wb is not None:
-                        (wb_h, wb_hits, wb_lim, wb_dur, wb_algo,
-                         wb_burst) = wb
-                        wb_sh = (
-                            shard_of_hash(wb_h, n_shards).astype(np.int32)
-                            if n_shards > 1 else None
-                        )
-                        wrnd, wlane, wn = native.assign_rounds(
-                            wb_h, wb_sh, n_shards, B
-                        )
-                        m = len(wb_h)
-                        wvals = dict(
-                            key_hash=wb_h, hits=wb_hits, limit=wb_lim,
-                            duration=wb_dur, algo=wb_algo, burst=wb_burst,
-                            reset_remaining=np.zeros(m, dtype=bool),
-                            is_greg=np.zeros(m, dtype=bool),
-                            greg_expire=np.zeros(m, dtype=np.int64),
-                            greg_duration=np.zeros(m, dtype=np.int64),
-                        )
-                        wb_rounds, _, _ = _build_rounds(
-                            wvals, wrnd, wlane,
-                            wb_sh if wb_sh is not None
-                            else np.zeros(m, dtype=np.int32),
-                            wn, n_shards, B,
-                        )
-                        backend._dispatch_rounds_locked(wb_rounds)
-                if do_store:
-                    from gubernator_tpu.runtime.backend import (
-                        _packed_resp_dict,
-                        fetch_ravel,
-                    )
+        host_box: List = []  # [host] once the response reaches host
 
-                    now_ms = backend.clock.millisecond_now()
-                    cap_fps = np.array(
-                        [fp for fp, v in uniq.items() if v[2] is not None],
-                        dtype=np.int64,
+        def finish() -> List[Tuple[np.ndarray, ...]]:
+            return self._finish_process(
+                entries, host_box[0], rounds, h, h_mach, foundv, persv,
+                status, out_lim, remaining, reset, stored, stored_st,
+                t_step0,
+            )
+
+        if plan is None and not do_store:
+            # Plain merge: dispatch under the backend lock; the response
+            # sync rides the coalescer's FETCH stage, so the next
+            # maximal merge dispatches while this one's response syncs
+            # (depth bounded by GUBER_PIPELINE_DEPTH).
+            fetch_host = backend.step_rounds_begin(
+                rounds, add_tally=False
+            )
+
+            def fetch_plain() -> List[Tuple[np.ndarray, ...]]:
+                host_box.append(fetch_host())
+                gather(host_box[0])
+                return finish()
+
+            return fetch_plain
+
+        # Cascade merge: the read -> host replay -> write-back window
+        # must not interleave with ANY other step on these keys — from
+        # this lane, the object path, or the GLOBAL managers — so the
+        # whole window runs under the backend lock (the same
+        # single-writer discipline as every other mutation path).  The
+        # write-back itself needs no response sync: the replay already
+        # produced every response, and dispatch order serializes it.
+        #
+        # Store drains take this branch too, with NO pre-step
+        # residency probe: the step itself answers residency through
+        # its `found` column, so a warm drain pays ONE combined
+        # response+capture fetch — storeless parity — instead of the
+        # probe fetch + combined fetch it used to (algorithms.go:45-51
+        # consults the store only on cache miss; misses repair below).
+        # The lock is held through the fetch: a cold key was served
+        # from a FRESH row that the repair replaces, and no other
+        # drain may observe the interim state.  These in-lock fetches
+        # belong to the DISPATCH stage by necessity; what moves to the
+        # fetch stage is the rf fetch + write-through delivery below.
+        cap_token = wt_seq = None
+        cap_fps = int_hosts = None
+        with backend._lock:
+            resps = backend._dispatch_rounds_locked(rounds)
+            if plan is not None:
+                host_box.append(to_host(resps))
+                gather(host_box[0])
+                wb = _run_cascade(
+                    plan, h, hits, lim, dur, algo, burst,
+                    status, out_lim, remaining, reset, stored, cachedv,
+                    stored_st,
+                )
+                if wb is not None:
+                    (wb_h, wb_hits, wb_lim, wb_dur, wb_algo,
+                     wb_burst) = wb
+                    wb_sh = (
+                        shard_of_hash(wb_h, n_shards).astype(np.int32)
+                        if n_shards > 1 else None
                     )
-                    # Optimistic capture: dispatched with the step so the
-                    # warm path fetches response + capture in ONE
-                    # round-trip; a repair below re-dispatches it.
-                    cap_token = backend._gather_rows_dispatch(
-                        cap_fps, now_ms
+                    wrnd, wlane, wn = native.assign_rounds(
+                        wb_h, wb_sh, n_shards, B
                     )
-                    cap_ints = backend._gather_rows_int_arrays(cap_token)
-                    if plan is None:
-                        hosts = fetch_ravel(list(resps) + cap_ints)
-                        nr = len(resps)
-                        host = [_packed_resp_dict(hh) for hh in hosts[:nr]]
-                        gather(host)
-                        int_hosts = hosts[nr:]
-                    else:
-                        int_hosts = fetch_ravel(cap_ints)
-                    rep = self._repair_cold_store_keys(
-                        backend, uniq, foundv, h, dict(
-                            hits=hits, limit=lim, duration=dur, algo=algo,
-                            burst=burst, reset_remaining=reset_remaining,
-                            is_greg=is_greg, greg_expire=ge,
-                            greg_duration=gd, use_cached=use_cached,
-                        ),
-                        sh_all, n_shards, B, now_ms,
-                        (status, out_lim, remaining, reset, stored,
-                         cachedv, stored_st),
+                    m = len(wb_h)
+                    wvals = dict(
+                        key_hash=wb_h, hits=wb_hits, limit=wb_lim,
+                        duration=wb_dur, algo=wb_algo, burst=wb_burst,
+                        reset_remaining=np.zeros(m, dtype=bool),
+                        is_greg=np.zeros(m, dtype=bool),
+                        greg_expire=np.zeros(m, dtype=np.int64),
+                        greg_duration=np.zeros(m, dtype=np.int64),
                     )
-                    if rep is not None:
-                        # Rows changed under the optimistic capture —
-                        # refetch it (packed with the repair responses
-                        # inside _repair_cold_store_keys).
-                        cap_token, int_hosts = rep
-                    wt_seq = backend._wt_ticket()
+                    wb_rounds, _, _ = _build_rounds(
+                        wvals, wrnd, wlane,
+                        wb_sh if wb_sh is not None
+                        else np.zeros(m, dtype=np.int32),
+                        wn, n_shards, B,
+                    )
+                    backend._dispatch_rounds_locked(wb_rounds)
             if do_store:
+                from gubernator_tpu.runtime.backend import (
+                    _packed_resp_dict,
+                    fetch_ravel,
+                )
+
+                now_ms = backend.clock.millisecond_now()
+                cap_fps = np.array(
+                    [fp for fp, v in uniq.items() if v[2] is not None],
+                    dtype=np.int64,
+                )
+                # Optimistic capture: dispatched with the step so the
+                # warm path fetches response + capture in ONE
+                # round-trip; a repair below re-dispatches it.
+                cap_token = backend._gather_rows_dispatch(
+                    cap_fps, now_ms
+                )
+                cap_ints = backend._gather_rows_int_arrays(cap_token)
+                if plan is None:
+                    hosts = fetch_ravel(list(resps) + cap_ints)
+                    nr = len(resps)
+                    host_box.append(
+                        [_packed_resp_dict(hh) for hh in hosts[:nr]]
+                    )
+                    gather(host_box[0])
+                    int_hosts = hosts[nr:]
+                else:
+                    int_hosts = fetch_ravel(cap_ints)
+                rep = self._repair_cold_store_keys(
+                    backend, uniq, foundv, h, dict(
+                        hits=hits, limit=lim, duration=dur, algo=algo,
+                        burst=burst, reset_remaining=reset_remaining,
+                        is_greg=is_greg, greg_expire=ge,
+                        greg_duration=gd, use_cached=use_cached,
+                    ),
+                    sh_all, n_shards, B, now_ms,
+                    (status, out_lim, remaining, reset, stored,
+                     cachedv, stored_st),
+                )
+                if rep is not None:
+                    # Rows changed under the optimistic capture —
+                    # refetch it (packed with the repair responses
+                    # inside _repair_cold_store_keys).
+                    cap_token, int_hosts = rep
+                wt_seq = backend._wt_ticket()
+
+        def fetch_locked_merge() -> List[Tuple[np.ndarray, ...]]:
+            # Fetch stage of a cascade/store merge: the response host
+            # sync already happened inside the lock (cascade/repair
+            # correctness); what remains is the remaining_f fetch, the
+            # capture build, and the Store.on_change delivery — user
+            # code plus a ticket wait that must never block the next
+            # merge's dispatch.
+            if do_store:
+                from gubernator_tpu.runtime.backend import fetch_ravel
+
                 captured: list = []
                 try:
                     rf_hosts = (
@@ -1699,14 +1933,30 @@ class FastPath:
                         uniq, cap_fps, a_cols, rf_col
                     )
                 finally:
-                    # The ticket MUST be redeemed even if any fetch fails
-                    # (the step already happened; a skipped redemption
-                    # wedges every later delivery in cond.wait) — hence
-                    # the response sync sits INSIDE this try as well.
+                    # The ticket MUST be redeemed even if any fetch
+                    # fails (the step already happened; a skipped
+                    # redemption wedges every later delivery in
+                    # cond.wait) — hence the rf sync sits INSIDE this
+                    # try as well.
                     backend._deliver_write_through(captured, wt_seq)
-            # else: plan is not None (the branch condition), so the host
-            # sync already happened inside the lock for the cascade.
+            return finish()
 
+        return fetch_locked_merge
+
+    def _finish_process(
+        self, entries, host, rounds, h, h_mach, foundv, persv,
+        status, out_lim, remaining, reset, stored, stored_st, t_step0,
+    ) -> List[Tuple[np.ndarray, ...]]:
+        """Shared tail of a machinery merge's fetch stage: tallies,
+        flight-recorder record, spill pressure, the GLOBAL capture-
+        validity mask, and the per-entry split."""
+        from gubernator_tpu.runtime.backend import (
+            Tally,
+            tally_from_rounds,
+        )
+
+        backend = self.s.backend
+        n = len(h)
         # Metric parity: checks/over-limit from the per-REQUEST outputs
         # (cascade occurrences never had their own device lane); cache
         # hit/miss + eviction tallies from the device rounds.

@@ -148,6 +148,16 @@ class FlightRecorder:
             **({"peer": peer} if peer else {}),
         )
 
+    def record_bubble(self, lane: str, wait_ms: float) -> None:
+        """One pipelined-drain bubble (runtime/fastpath.py): a ready
+        merge stalled `wait_ms` waiting for a fetch slot while the
+        dispatch stage sat idle.  Sustained bubbles with saturated
+        pipeline occupancy are the signal to raise
+        GUBER_PIPELINE_DEPTH."""
+        self.record(
+            "fastlane_bubble", lane=lane, wait_ms=round(wait_ms, 3)
+        )
+
     def observe_request(self, duration_s: float) -> None:
         """One served request's latency into the rolling SLO window."""
         self._lat.append((time.monotonic(), duration_s))

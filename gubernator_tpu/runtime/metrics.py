@@ -260,6 +260,43 @@ class Metrics:
             registry=r,
         )
 
+        # -- compiled fast lane: pipelined drain (runtime/fastpath.py) ----
+        self.fastpath_drains = Counter(
+            "gubernator_fastpath_drains_total",
+            "Fast-lane coalescer drains by lane (mach/sketch/engine) and "
+            "kind: total = every drain, overlap = rode a sparse fetch "
+            "slot, waited = stalled for a fetch slot (one pipeline "
+            "bubble each).",
+            ["lane", "kind"],
+            registry=r,
+        )
+        self.fastpath_stage_duration = Histogram(
+            "gubernator_fastpath_stage_duration",
+            "Wall time of one pipelined-drain stage in seconds: "
+            "dispatch (pack + device dispatch, serialized) vs fetch "
+            "(device->host readback + unmarshal, depth "
+            "GUBER_PIPELINE_DEPTH).",
+            ["lane", "stage"],
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.fastpath_pipeline_occupancy = Histogram(
+            "gubernator_fastpath_pipeline_occupancy",
+            "Merges in flight (dispatch or fetch stage) when a drain "
+            "entered its pipeline, by lane — sustained occupancy near "
+            "the configured depth means a deeper pipeline may help.",
+            ["lane"],
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
+            registry=r,
+        )
+        self.fastpath_bubble_seconds = Counter(
+            "gubernator_fastpath_bubble_seconds_total",
+            "Cumulative time a ready drain spent stalled waiting for a "
+            "fetch slot (dispatch idle — the pipeline bubble), by lane.",
+            ["lane"],
+            registry=r,
+        )
+
         # -- TPU-specific -------------------------------------------------
         self.device_step_duration = Histogram(
             "gubernator_tpu_device_step_duration",

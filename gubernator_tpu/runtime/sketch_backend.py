@@ -310,6 +310,20 @@ class SketchBackend:
         fingerprint / hits / limit arrays in, (status, remaining,
         reset_time) int64 arrays out.  Validation happens upstream (the
         wire parser's err column / check()'s request validation)."""
+        return self.check_cols_begin(key_hash, hits, limits)()
+
+    def check_cols_begin(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limits: np.ndarray,
+    ):
+        """Dispatch stage of check_cols: clamp/pad/chunk and issue the
+        ONE device dispatch under the lock, then return a zero-arg fetch
+        closure producing (status, remaining, reset_time).  The closure
+        syncs this merge's own output buffer (only the state is
+        donated), so the pipelined fast lane runs it on its fetch stage
+        while the next merge dispatches."""
         n = len(key_hash)
         # Sketch cells are int32; clamp limits/hits into range ONCE so
         # the device decision and the host-side `remaining` agree (an
@@ -340,16 +354,21 @@ class SketchBackend:
             self._advance_window(int(now))
             reset_val = self._win_start + self.cfg.window_ms
             self.state, packed = step(self.state, kh, hc, lc, np.int64(now))
-        # Response sync OUTSIDE the lock: `packed` is this call's own
-        # output buffer (only the state is donated), so later dispatches
-        # can't touch it — merges pipeline like the exact lane.
-        out = np.asarray(packed)
-        over = out[:, 0, :].reshape(-1)[:n]
-        est = out[:, 1, :].reshape(-1)[:n].astype(np.int64)
-        status = over.astype(np.int64)
-        remaining = np.maximum(0, limits - est - np.maximum(hits, 0))
-        reset = np.full(n, reset_val, dtype=np.int64)
-        return status, remaining, reset
+
+        def fetch() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+            # Response sync OUTSIDE the lock: `packed` is this call's own
+            # output buffer (only the state is donated), so later
+            # dispatches can't touch it — merges pipeline like the exact
+            # lane.
+            out = np.asarray(packed)
+            over = out[:, 0, :].reshape(-1)[:n]
+            est = out[:, 1, :].reshape(-1)[:n].astype(np.int64)
+            status = over.astype(np.int64)
+            remaining = np.maximum(0, limits - est - np.maximum(hits, 0))
+            reset = np.full(n, reset_val, dtype=np.int64)
+            return status, remaining, reset
+
+        return fetch
 
     def check(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
         from gubernator_tpu import native

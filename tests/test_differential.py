@@ -266,3 +266,101 @@ def test_go_trunc_differential():
             f"_go_trunc diverged at {v!r}: kernel {int(got)}, "
             f"oracle {want}"
         )
+
+
+def test_pipeline_depth_differential(frozen_clock):
+    """Pipelined drain is semantics-preserving: the same concurrent
+    traffic through a depth-1 and a depth-3 compiled fast lane produces
+    bit-identical responses and final table rows.  Workers own disjoint
+    key spaces, so each key's history is deterministic no matter how the
+    coalescer composes merges — any response difference is a real
+    stale-table/ordering bug, not schedule noise."""
+    import asyncio
+
+    from gubernator_tpu import native
+    from gubernator_tpu.core.config import Config
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    dev = DeviceConfig(num_slots=4096, ways=8, batch_size=64)
+    n_workers, per_worker = 4, 12
+    rng = random.Random(11)
+
+    def worker_payloads(w: int):
+        payloads = []
+        for _ in range(per_worker):
+            reqs = []
+            for _ in range(rng.randrange(1, 12)):
+                behavior = 0
+                duration = rng.choice([60_000, 60_000, 1_000])
+                if rng.random() < 0.10:
+                    behavior |= int(Behavior.RESET_REMAINING)
+                if rng.random() < 0.08:
+                    behavior |= int(Behavior.DURATION_IS_GREGORIAN)
+                    duration = rng.choice([1, 4])
+                reqs.append(pb.RateLimitReq(
+                    name=f"pd{w}",
+                    unique_key=f"k{rng.randrange(6)}",
+                    hits=rng.choice([0, 1, 1, 2, 3, -1]),
+                    limit=rng.choice([20, 30]),
+                    duration=duration,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 25]),
+                ))
+            payloads.append(
+                pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+            )
+        return payloads
+
+    schedules = [worker_payloads(w) for w in range(n_workers)]
+
+    def run_at_depth(depth: int):
+        async def scenario():
+            svc = Service(Config(device=dev), clock=frozen_clock)
+            await svc.start()
+            fp = FastPath(svc, pipeline_depth=depth)
+            results: dict = {}
+
+            async def worker(w: int):
+                await asyncio.sleep(w * 0.003)
+                got = []
+                for payload in schedules[w]:
+                    raw = await fp.check_raw(payload, peer_rpc=False)
+                    assert raw is not None
+                    got.append([
+                        (r.status, r.limit, r.remaining, r.reset_time,
+                         r.error)
+                        for r in pb.GetRateLimitsResp.FromString(
+                            raw
+                        ).responses
+                    ])
+                results[w] = got
+
+            await asyncio.gather(*(worker(w) for w in range(n_workers)))
+            drains = fp._mach.drains
+            rows = {}
+            for w in range(n_workers):
+                for k in range(6):
+                    key = f"pd{w}_k{k}"
+                    item = svc.backend.get_cache_item(key)
+                    rows[key] = (
+                        (item.remaining, item.expire_at, int(item.status),
+                         item.limit, item.duration)
+                        if item is not None else None
+                    )
+            await fp.close()
+            await svc.close()
+            return results, rows, drains
+
+        return asyncio.run(scenario())
+
+    base_results, base_rows, _ = run_at_depth(1)
+    deep_results, deep_rows, deep_drains = run_at_depth(3)
+    assert deep_results == base_results
+    assert deep_rows == base_rows
+    assert deep_drains >= 2  # traffic really coalesced into many merges

@@ -62,7 +62,19 @@ VAR_ALIAS = {
 # flightrec._lock ranks LAST: any layer may record into the flight
 # recorder while holding its own lock (e.g. under backend._lock in a
 # drain), and the recorder never takes another lock while holding its own.
+#
+# The fast lane's pipelined-drain stage slots (_Coalescer._dispatch_sem /
+# _fetch / _overlap, runtime/fastpath.py) are asyncio SEMAPHORES acquired
+# on the event loop, ranked BEFORE every thread lock here: a drain takes
+# fetch slot -> dispatch slot -> (on a pool thread) backend._lock, and
+# nothing acquires a stage slot while holding a thread lock.  They are
+# declared for the record; the lexical checker only sees `with` blocks
+# over *_lock attributes, and raceguard's runtime graph covers
+# asyncio.Lock — a future conversion of these slots to locks must keep
+# this order.
 RANK = {
+    "coalescer._fetch_slot": 1,
+    "coalescer._dispatch_slot": 2,
     "backend._keymap_lock": 10,
     "backend._lock": 20,
     "engine._lock": 30,

@@ -24,6 +24,15 @@ lineage the taint walk ignores by construction), `[2]` hits the bare
 
 Declared-cast budgets cite the deliberate conversion they license; the
 dtype checker fails on the budget+1'th cast with its source line.
+
+The pipelined drain (docs/pipeline.md) deliberately adds NO kernels:
+its dispatch/fetch split is host-side orchestration over the
+entrypoints already registered here (apply_batch_packed_q,
+sharded_step_packed, sketch_multi_step, global_sync_step, the gather/
+probe ops), so the golden primitive budgets are unchanged — the
+completeness checker (AST scan for module-level jax.jit) stays the
+authority that any future chained-dispatch kernel must land in this
+file.
 """
 from __future__ import annotations
 
