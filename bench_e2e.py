@@ -103,7 +103,9 @@ def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
 
 
 def bench(seconds: float, concurrency: int,
-          depth_sweep: Tuple[int, ...] = (1, 2, 4)) -> None:
+          depth_sweep: Tuple[int, ...] = (1, 2, 4),
+          serve_sweep: Tuple[str, ...] = ("classic", "pipelined", "ring"),
+          ) -> None:
     """Sync driver: client coroutines run on each cluster's OWN loop —
     grpc.aio multiplexes one poller per process, and a second event loop
     polling it (server on the cluster loop, clients on another) thrashes
@@ -133,13 +135,19 @@ def bench(seconds: float, concurrency: int,
     from gubernator_tpu.core.config import (
         fastpath_sparse_from_env,
         pipeline_depth_from_env,
+        ring_slots_from_env,
+        serve_mode_from_env,
     )
 
     sparse = fastpath_sparse_from_env()
     depth = pipeline_depth_from_env()
+    serve_mode = serve_mode_from_env()
+    ring_slots = ring_slots_from_env()
 
     def conf(**kw) -> DaemonConfig:
         kw.setdefault("pipeline_depth", depth)
+        kw.setdefault("serve_mode", serve_mode)
+        kw.setdefault("ring_slots", ring_slots)
         return DaemonConfig(fastpath_sparse=sparse, **kw)
 
     rng = np.random.default_rng(7)
@@ -451,10 +459,96 @@ def bench(seconds: float, concurrency: int,
                 "waited": mach.waited_drains,
                 "max_inflight_seen": mach.max_inflight_seen,
             }
+            # Ring acceptance split (docs/ring.md): blocking device->
+            # host fetches performed ON the request path, per check —
+            # 0 in steady-state ring mode — plus the ring's own
+            # slot-wait (the backpressure term that replaces the
+            # pipelined bubble).
+            bf = sum(fp.blocking_fetches.values())
+            budget["serve_mode"] = fp.effective_serve_mode
+            budget["blocking_fetches"] = dict(fp.blocking_fetches)
+            budget["blocking_fetches_per_check"] = round(
+                bf / fp.served, 6
+            )
+            if fp._ring is not None:
+                rdv = fp._ring.debug_vars()
+                budget["ring_slot_wait_us_per_1000"] = round(
+                    rdv["slot_wait_ms_total"] * 1e3 / per_k
+                )
+                budget["ring"] = rdv
         results.append(budget)
         print(json.dumps(budget), flush=True)
     finally:
         c.stop()
+
+    # ---- serve-mode sweep: classic vs pipelined vs ring ----------------
+    # Re-run the two throughput configs and the small-batch latency
+    # config per drain discipline on fresh single-node daemons; the
+    # acceptance bar is ring-mode blocking_fetches_per_check == 0 with
+    # small-batch p50 at or below the pipelined baseline.
+    for mode in serve_sweep:
+        try:
+            c = Cluster.start_with(
+                [""], device=dev_cfg,
+                conf_template=conf(serve_mode=mode),
+            )
+            try:
+                addr = [c.daemons[0].grpc_address]
+                sweep_seconds = max(2.0, seconds / 2)
+                pays = [build_payload(
+                    [("bench_token", f"k{i}") for i in range(1000)]
+                )]
+                zipf_pays = []
+                for _ in range(32):
+                    ks = rng.zipf(1.3, size=1000) % 1_000_000
+                    zipf_pays.append(build_payload(
+                        [("bench_leaky", f"z{k}") for k in ks],
+                        algorithm=1, limit=1_000_000, duration=60_000,
+                    ))
+                small = [build_payload(
+                    [("bench_lat", f"l{j}") for j in range(10)]
+                )]
+                for name, pl, batch, cc in (
+                    ("token_1k_batch1000", pays, 1000, concurrency),
+                    ("leaky_1m_zipfian", zipf_pays, 1000, concurrency),
+                    ("latency_small_batch", small, 10, 4),
+                ):
+                    c.run(drive(addr, pl, 0.5, cc), timeout=120)  # warm
+                    t0 = time.perf_counter()
+                    rpcs, lat = c.run(
+                        drive(addr, pl, sweep_seconds, cc), timeout=120
+                    )
+                    emit(f"serve_sweep_{name}", rpcs * batch, rpcs,
+                         lat, time.perf_counter() - t0,
+                         {"serve_mode": mode, "concurrency": cc})
+                fp = c.daemons[0].fastpath
+                mach = fp._mach
+                bf = sum(fp.blocking_fetches.values())
+                line = {
+                    "config": "serve_sweep_stages",
+                    "serve_mode": mode,
+                    "effective_serve_mode": fp.effective_serve_mode,
+                    "dispatch_s": round(mach.dispatch_s, 3),
+                    "fetch_s": round(mach.fetch_s, 3),
+                    "bubble_s": round(mach.bubble_s, 3),
+                    "drains": mach.drains,
+                    "served": fp.served,
+                    "blocking_fetches": dict(fp.blocking_fetches),
+                    "blocking_fetches_per_check": round(
+                        bf / max(fp.served, 1), 6
+                    ),
+                }
+                if fp._ring is not None:
+                    line["ring"] = fp._ring.debug_vars()
+                results.append(line)
+                print(json.dumps(line), flush=True)
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate sweep failures
+            print(json.dumps({
+                "config": "serve_sweep", "serve_mode": mode,
+                "error": str(e),
+            }))
 
     # ---- pipeline-depth sweep: the tentpole A/B ------------------------
     # Re-run the two throughput configs (token_1k dense batches,
@@ -685,6 +779,9 @@ def bench(seconds: float, concurrency: int,
         "fastpath_sparse": sparse,
         "pipeline_depth": depth,
         "pipeline_depth_sweep": list(depth_sweep),
+        "serve_mode": serve_mode,
+        "ring_slots": ring_slots,
+        "serve_mode_sweep": list(serve_sweep),
         "device": {
             "num_slots": dev_cfg.num_slots,
             "batch_size": dev_cfg.batch_size,
@@ -704,11 +801,22 @@ def main() -> None:
         help="comma-separated GUBER_PIPELINE_DEPTH sweep re-running the "
         "throughput + small-batch configs per depth (empty disables)",
     )
+    ap.add_argument(
+        "--serve-mode", default="classic,pipelined,ring",
+        help="comma-separated GUBER_SERVE_MODE sweep re-running the "
+        "throughput + small-batch configs per drain discipline "
+        "(empty disables); the ring entry reports the fetch-free "
+        "budget split (docs/ring.md)",
+    )
     args = ap.parse_args()
     sweep = tuple(
         int(d) for d in args.pipeline_depth.split(",") if d.strip()
     )
-    bench(args.seconds, args.concurrency, depth_sweep=sweep)
+    modes = tuple(
+        m.strip() for m in args.serve_mode.split(",") if m.strip()
+    )
+    bench(args.seconds, args.concurrency, depth_sweep=sweep,
+          serve_sweep=modes)
 
 
 if __name__ == "__main__":

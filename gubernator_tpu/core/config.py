@@ -102,6 +102,32 @@ def normalize_degraded_mode(value: str) -> str:
     return v
 
 
+# Fast-lane drain disciplines (runtime/fastpath.py; docs/ring.md):
+#   classic    — strict depth-1: every merge's dispatch AND fetch
+#                serialize end to end (the pre-PR5 discipline);
+#   pipelined  — dispatch serialized, device->host fetches overlapped at
+#                GUBER_PIPELINE_DEPTH (PR 5);
+#   ring       — the device-resident serving loop (runtime/ring.py):
+#                merges enter a request ring, ONE runner thread drives
+#                bounded jitted multi-round scans and publishes
+#                responses, and the request path never blocks on a
+#                device->host fetch.  Falls back to pipelined on
+#                backends without single-table ring support (mesh).
+SERVE_MODES = ("classic", "pipelined", "ring")
+
+
+def normalize_serve_mode(value: str) -> str:
+    """Canonicalize a serve mode; raise on anything unknown — a typo
+    must not silently drop the daemon to a slower discipline."""
+    v = (value or "").strip().lower() or "pipelined"
+    if v not in SERVE_MODES:
+        raise ValueError(
+            f"unknown serve mode {value!r}; expected one of "
+            + ", ".join(repr(m) for m in SERVE_MODES)
+        )
+    return v
+
+
 @dataclass
 class DeviceConfig:
     """TPU-specific geometry (no reference analog — replaces the Go worker
@@ -278,6 +304,17 @@ class DaemonConfig:
     # serialized end to end); raise past 2 only if pipeline-occupancy
     # telemetry shows the depth saturated AND bubble time is nonzero.
     pipeline_depth: int = 2
+    # Fast-lane drain discipline (SERVE_MODES; docs/ring.md).  "ring"
+    # takes host fetches off the request path entirely: enqueue ->
+    # poll response slot, with the device loop fed by a request ring.
+    serve_mode: str = "pipelined"
+    # Request-ring capacity in ROUNDS (GUBER_RING_SLOTS): how many
+    # packed [12, B] rounds one ring iteration may consume (the bounded
+    # jitted scan's slot budget) and how many may queue before
+    # producers block (backpressure, measured as ring slot-wait).
+    # Each power-of-two tier up to this costs one XLA compile at
+    # warmup.
+    ring_slots: int = 8
     # Flight recorder / SLO telemetry (runtime/flightrec.py).  Off by
     # default: the ring + sampler are cheap, but dumps write to disk and
     # operators should choose the directory.
@@ -440,6 +477,27 @@ def pipeline_depth_from_env() -> int:
     )
 
 
+def serve_mode_from_env() -> str:
+    """The fast-lane drain-discipline knob (GUBER_SERVE_MODE), parsed/
+    validated exactly as the daemon does — rejects unknown modes at
+    startup (same harness contract as pipeline_depth_from_env)."""
+    return normalize_serve_mode(_env("GUBER_SERVE_MODE", "pipelined"))
+
+
+def ring_slots_from_env() -> int:
+    """The request-ring capacity knob (GUBER_RING_SLOTS), validated at
+    daemon startup: fewer than 1 slot cannot hold a round, and past
+    1024 the per-tier XLA compiles + the padded scan's wasted work
+    outgrow any coalescing win — both are config mistakes, not
+    tunings."""
+    v = _require_min(
+        "GUBER_RING_SLOTS", _env_int("GUBER_RING_SLOTS", 8), 1
+    )
+    if v > 1024:
+        raise ValueError(f"GUBER_RING_SLOTS must be <= 1024, got {v}")
+    return v
+
+
 def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     """Build a DaemonConfig from GUBER_* env vars (config.go:253-459)."""
     if config_file:
@@ -558,6 +616,8 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         fastpath_sparse=fastpath_sparse_from_env(),
         pipeline_depth=pipeline_depth_from_env(),
+        serve_mode=serve_mode_from_env(),
+        ring_slots=ring_slots_from_env(),
         flightrec=_env("GUBER_FLIGHTREC") in ("1", "true"),
         flightrec_dir=_env("GUBER_FLIGHTREC_DIR", "flightrec-dumps"),
         flightrec_ring=_require_min(

@@ -264,7 +264,15 @@ class Daemon:
             max_inflight=getattr(self.conf, "fastpath_inflight", 1),
             sparse_limit=getattr(self.conf, "fastpath_sparse", 64),
             pipeline_depth=getattr(self.conf, "pipeline_depth", 2),
+            serve_mode=getattr(self.conf, "serve_mode", "pipelined"),
+            ring_slots=getattr(self.conf, "ring_slots", 8),
         )
+        if self.fastpath._ring is not None:
+            # Compile every ring block shape up front — a cold scan
+            # compile inside a serving iteration is a p99 cliff.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.fastpath._ring.warmup
+            )
 
         # gRPC server (daemon.go:101-126): both services on one listener.
         # 4MB recv cap: grpc-go's default, which reference peers assume.

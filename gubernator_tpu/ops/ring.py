@@ -1,0 +1,89 @@
+"""The ring-fed device loop kernel: one jitted call drains many rounds.
+
+The classic and pipelined drain disciplines dispatch one `apply_batch`
+round at a time and pay one device->host fetch per MERGE on the request
+path (runtime/fastpath.py).  The ring discipline (runtime/ring.py,
+GUBER_SERVE_MODE=ring) instead stacks every queued round into one
+int64[k, 12, B] request-ring block and applies the whole block in a
+single jitted scan:
+
+    table', resps[k, 9, B], seq' = ring_step(table, qs, nows, seq)
+
+Rounds apply IN ORDER (a duplicate-key merge's sequential rounds keep
+observing each other's effects exactly as the round-at-a-time loop in
+`_dispatch_rounds_locked` does), the table state is donated so the loop
+updates in place, and `seq` — the ring's monotonically increasing
+sequence word — advances by the consumed slot count and travels back
+packed with the responses.  The host ring runner fetches (resps, seq)
+in ONE transfer, off the request path, and publishes each round's
+response to its waiting slot; the request path is enqueue -> wait on
+the slot, with no blocking `device_get` anywhere.  The seq word is NOT
+donated: under the runner's double buffering, iteration N's output word
+must stay fetchable after iteration N+1 has already dispatched with it
+as input — donating it would delete the very buffer the response
+protocol spins on.
+
+Inactive padding rounds (all-zero q rows: active column false on every
+lane) are no-ops by construction — the ring pads a partial block up to
+the smallest compiled slot tier so XLA never sees a new shape
+(core/config.py's fixed-shape rule; one compile per tier at warmup).
+
+The k=1 block is semantically `apply_batch_packed_q` plus the sequence
+word; the differential suite pins ring mode bit-identical to the
+classic drain (tests/test_differential.py, scripts/ring_smoke.py).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.state import SlotTable
+from gubernator_tpu.ops.step import apply_batch_packed_q_impl
+
+
+def ring_step_impl(
+    table: SlotTable,
+    qs: jax.Array,    # int64[k, 12, B] — k stacked request rounds
+    nows: jax.Array,  # int64[k] — per-round clock (one value per block
+    #                   in practice; per-slot for exactness under test)
+    seq: jax.Array,   # int64[] — the ring sequence word
+    ways: int = 8,
+) -> Tuple[SlotTable, jax.Array, jax.Array]:
+    """Apply `k` packed rounds in order; returns
+    (new_table, int64[k, 9, B] packed responses, seq + k)."""
+
+    def body(tbl, qn):
+        q, now = qn
+        tbl, resp = apply_batch_packed_q_impl(tbl, q, now, ways=ways)
+        return tbl, resp
+
+    table, resps = jax.lax.scan(body, table, (qs, nows))
+    return table, resps, seq + jnp.int64(qs.shape[0])
+
+
+ring_step = jax.jit(
+    ring_step_impl, static_argnames=("ways",), donate_argnums=(0,)
+)
+
+
+def resolve_ring_tiers(slots: int) -> Tuple[int, ...]:
+    """Compiled slot-count tiers for the ring block: powers of two up to
+    `slots` (each costs one XLA compile at warmup; a partial block pads
+    to the smallest tier that holds it, so the scan never recompiles)."""
+    tiers = []
+    t = 1
+    while t < slots:
+        tiers.append(t)
+        t <<= 1
+    tiers.append(slots)
+    return tuple(tiers)
+
+
+def ring_tier_of(k: int, tiers: Tuple[int, ...]) -> int:
+    """Smallest compiled tier holding `k` stacked rounds."""
+    for t in tiers:
+        if k <= t:
+            return t
+    return tiers[-1]

@@ -272,6 +272,14 @@ class MeshBackend(PersistenceHost):
         self.over_limit = 0
         self.not_persisted = 0
 
+    def ring_supported(self) -> bool:
+        """The ring drain discipline (runtime/ring.py) scans a single
+        donated SlotTable; the sharded grid table would need a
+        shard_map-wrapped scan kernel.  Until that lands, mesh services
+        fall back to the depth-k pipelined discipline (docs/ring.md's
+        fallback rule) — step_rounds_begin already overlaps fetches."""
+        return False
+
     def _add_tally(self, tally) -> None:
         with self._lock:
             self.checks += tally.checks

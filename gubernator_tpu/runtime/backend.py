@@ -494,6 +494,41 @@ class DeviceBackend(PersistenceHost):
             )
         return round_resps
 
+    # -- ring drain discipline (runtime/ring.py) -------------------------
+    def ring_supported(self) -> bool:
+        """The ring loop scans a single donated SlotTable; the mesh
+        backend overrides this to False (its table is shard_map-sharded;
+        the fast lane falls back to the pipelined discipline there)."""
+        return True
+
+    def ring_seq_init(self):
+        """A fresh device-resident sequence word for a RingBackend."""
+        import jax.numpy as jnp
+
+        with jax.default_device(self._device):
+            return jnp.zeros((), dtype=jnp.int64)
+
+    def ring_step_dispatch(self, qs: np.ndarray, nows: np.ndarray, seq):
+        """Dispatch one bounded ring iteration — `qs` int64[k, 12, B]
+        stacked rounds applied in order by ops/ring.ring_step — under
+        the lock (the same single-writer section as every other table
+        mutation, so store write-through and the object path dispatch-
+        order against ring steps).  Returns the un-synced device
+        (responses, new seq word); the ring runner fetches them off the
+        request path."""
+        from gubernator_tpu.ops.ring import ring_step
+
+        t_start = time.monotonic()
+        with self._lock:
+            self.table, resps, seq = ring_step(
+                self.table, qs, nows, seq, ways=self.cfg.ways
+            )
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
+        return resps, seq
+
     def _probe_padded(self, hashes: np.ndarray, now: int) -> np.ndarray:
         """found-mask for a host hash vector, probing in fixed batch_size
         chunks so the jitted probe never sees a new shape (the fixed-shape

@@ -297,6 +297,31 @@ class Metrics:
             registry=r,
         )
 
+        # -- ring drain discipline (runtime/ring.py; docs/ring.md) --------
+        self.fastpath_ring_occupancy = Histogram(
+            "gubernator_fastpath_ring_occupancy",
+            "Request-ring rounds consumed per device-loop iteration "
+            "(before padding to the compiled slot tier) — sustained "
+            "occupancy at GUBER_RING_SLOTS with nonzero slot-wait means "
+            "a bigger ring may help.",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+            registry=r,
+        )
+        self.fastpath_ring_slot_wait = Histogram(
+            "gubernator_fastpath_ring_slot_wait",
+            "Time a merge spent blocked waiting for free request-ring "
+            "slots (ring-full backpressure) in seconds.",
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.fastpath_ring_loop_lag = Gauge(
+            "gubernator_fastpath_ring_loop_lag_seconds",
+            "Latest gap between consecutive ring device-loop dispatches "
+            "— the serving loop's heartbeat (large values while traffic "
+            "queues mean the runner is stuck on a host job or fetch).",
+            registry=r,
+        )
+
         # -- TPU-specific -------------------------------------------------
         self.device_step_duration = Histogram(
             "gubernator_tpu_device_step_duration",

@@ -1,0 +1,435 @@
+"""The ring drain discipline: a persistent device-loop runner that takes
+host fetches off the request path (GUBER_SERVE_MODE=ring).
+
+The classic and pipelined disciplines (runtime/fastpath._Coalescer) pay
+one blocking device->host fetch per merge ON the request path — PR 5
+overlapped those fetches across merges, but every merge still spends a
+fetch cycle inside its own latency.  The ring discipline removes the
+fetch from the request path entirely:
+
+  request ring   — producers (fast-lane pool threads) pack a merge's
+                   rounds into ring slots (`submit_rounds`) and return
+                   immediately with a wait handle; a full ring blocks
+                   the producer (backpressure, measured as slot-wait).
+  device loop    — ONE runner thread drains queued slots into a single
+                   bounded jitted scan (`ops/ring.ring_step`: donated
+                   table, up to GUBER_RING_SLOTS rounds per iteration, a
+                   monotonically increasing sequence word packed with
+                   the responses), double-buffered: iteration N+1
+                   dispatches before iteration N's responses are
+                   fetched, so the device never waits on the host.
+  response ring  — the runner fetches (responses, sequence word) in ONE
+                   transfer, verifies the sequence advanced exactly by
+                   the consumed slot count, and publishes each round's
+                   packed response to its waiting slot (a cheap event
+                   wake — no device interaction on the waiter's side).
+
+Merges that must fetch inside the backend lock (host-cascade replay,
+Store seeding/repair — fastpath._process's locked branch) ride the same
+runner as HOST JOBS (`submit_host`): the work runs verbatim on the
+runner thread, FIFO with the ring iterations, so store write-through
+tickets still dispatch-order against ring steps and the request path
+stays fetch-free even for those merges.
+
+Failure containment: a dispatch error marks the ring BROKEN and fails
+its jobs; the fast lane checks `available()` per merge and falls back
+to the depth-k pipelined discipline (docs/ring.md's fallback rule).
+`close()` finishes the in-flight iteration (its device effects already
+happened), fails never-started jobs, and joins the runner.
+
+On TPU backends with Pallas DMA support the same protocol maps onto a
+device-resident loop with host-pinned rings (docs/ring.md); this runner
+is the portable host-driven form and the semantic reference for it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.ops.ring import resolve_ring_tiers, ring_tier_of
+
+
+class _Job:
+    """One submitted unit: either `qs` (an int64[k, 12, B] request block
+    already in ring slot layout) or `fn` (a host job run verbatim on the
+    runner thread)."""
+
+    __slots__ = ("qs", "fn", "event", "result", "error")
+
+    def __init__(self, qs=None, fn=None) -> None:
+        self.qs = qs
+        self.fn = fn
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def publish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.event.set()
+
+    def wait(self):
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class RingClosedError(RuntimeError):
+    pass
+
+
+class RingBackend:
+    """Request/response rings + the persistent device-loop runner."""
+
+    def __init__(self, backend, slots: int = 8, metrics=None) -> None:
+        if slots < 1:
+            raise ValueError(f"ring slots must be >= 1, got {slots}")
+        if not getattr(backend, "ring_supported", lambda: False)():
+            raise ValueError(
+                f"{type(backend).__name__} does not support the ring "
+                "drain discipline"
+            )
+        self._backend = backend
+        self.slots = slots
+        self._tiers = resolve_ring_tiers(slots)
+        self._metrics = metrics
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._pending_rounds = 0  # queued, not yet taken by the runner
+        self._closed = False
+        self.broken = False
+        # Host mirror of the device sequence word (ops/ring.py): advances
+        # by the consumed TIER (padding slots included) per iteration;
+        # the fetch verifies the device word agrees.
+        self.seq = 0
+        self.seq_mismatches = 0
+        # Observability (debug_vars + the ring metrics).
+        self.iterations = 0
+        self.rounds_consumed = 0
+        self.padded_rounds = 0
+        self.host_jobs = 0
+        self.slot_wait_s = 0.0
+        self.slot_waits = 0
+        self.loop_lag_s = 0.0  # latest gap between consecutive dispatches
+        self.max_block = 0
+        self._last_dispatch = None
+        self._seq_dev = backend.ring_seq_init()
+        self._runner = threading.Thread(
+            target=self._run, name="tpu-ring-runner", daemon=True
+        )
+        self._runner.start()
+
+    # -- producer side ----------------------------------------------------
+    def available(self) -> bool:
+        """May a merge enter the ring?  False once closed or broken —
+        the fast lane then falls back to the pipelined discipline."""
+        return not self._closed and not self.broken
+
+    def submit_rounds(self, rounds: Sequence) -> Callable[[], list]:
+        """Convenience form of submit_q for DeviceBatch rounds (tests,
+        generic callers): pack them into ring slot layout first.  The
+        fast lane scatters its columns straight into the layout instead
+        (fastpath._build_rounds_q) — no DeviceBatch objects exist on
+        that path."""
+        from gubernator_tpu.runtime.backend import pack_batch_q, tier_of
+
+        be = self._backend
+        if not rounds:
+            return lambda: []
+        tb = max(tier_of(db.active, be._tiers) for db in rounds)
+        return self.submit_q(
+            np.stack([pack_batch_q(db)[:, :tb] for db in rounds])
+        )
+
+    def submit_q(self, qs: np.ndarray) -> Callable[[], list]:
+        """Queue one merge's request block — int64[k, 12, B] rounds
+        already in ring slot layout — into `k` ring slots; returns a
+        zero-arg wait producing the per-round host response dicts
+        (packed_rounds_to_host shape).  Blocks while the ring is full —
+        the backpressure the slot-wait metrics measure.
+
+        A merge WIDER than the ring (a duplicate-heavy batch whose
+        zero/negative-hit occurrences exploded into many sequential
+        rounds) splits into capacity-sized chunks submitted in order:
+        the FIFO queue + the in-order scan keep the rounds' effects
+        sequential across chunk boundaries, and the machinery lane's
+        serialized dispatch stage keeps other merges from interleaving
+        mid-merge submissions out of order."""
+        n = int(qs.shape[0])
+        if n == 0:
+            return lambda: []
+        if n > self.slots:
+            waits = [
+                self._submit_chunk(qs[lo:lo + self.slots])
+                for lo in range(0, n, self.slots)
+            ]
+
+            def wait_all() -> list:
+                out: list = []
+                for w in waits:
+                    out.extend(w())
+                return out
+
+            return wait_all
+        return self._submit_chunk(qs)
+
+    def _submit_chunk(self, qs: np.ndarray) -> Callable[[], list]:
+        n = int(qs.shape[0])
+        job = _Job(qs=qs)
+        t0 = time.monotonic()
+        waited = False
+        with self._cond:
+            while (
+                self._pending_rounds + n > self.slots
+                and not self._closed
+                and not self.broken
+            ):
+                waited = True
+                self._cond.wait(timeout=0.5)
+            if self._closed or self.broken:
+                raise RingClosedError(
+                    "ring closed" if self._closed else "ring broken"
+                )
+            self._pending_rounds += n
+            self._queue.append(job)
+            self._cond.notify_all()
+        if waited:
+            dt = time.monotonic() - t0
+            self.slot_wait_s += dt
+            self.slot_waits += 1
+            m = self._metrics
+            if m is not None:
+                m.fastpath_ring_slot_wait.observe(dt)
+        return job.wait
+
+    def submit_host(self, fn: Callable[[], object]) -> Callable[[], object]:
+        """Queue a host job (e.g. a locked cascade/store merge or a
+        sketch fetch) to run verbatim on the runner thread, FIFO with
+        the ring iterations; returns a zero-arg wait for fn's result.
+        Host jobs occupy no ring slots — their device work is their
+        own."""
+        job = _Job(fn=fn)
+        with self._cond:
+            if self._closed or self.broken:
+                raise RingClosedError(
+                    "ring closed" if self._closed else "ring broken"
+                )
+            self._queue.append(job)
+            self._cond.notify_all()
+        return job.wait
+
+    def debug_vars(self) -> dict:
+        return {
+            "slots": self.slots,
+            "seq": self.seq,
+            "seq_mismatches": self.seq_mismatches,
+            "iterations": self.iterations,
+            "rounds_consumed": self.rounds_consumed,
+            "padded_rounds": self.padded_rounds,
+            "host_jobs": self.host_jobs,
+            "slot_waits": self.slot_waits,
+            "slot_wait_ms_total": round(self.slot_wait_s * 1e3, 3),
+            "loop_lag_ms": round(self.loop_lag_s * 1e3, 3),
+            "max_block": self.max_block,
+            "broken": self.broken,
+        }
+
+    def warmup(self) -> None:
+        """Compile every (slot tier x batch tier) ring block shape so no
+        client merge pays a cold XLA compile mid-serving (the daemon
+        calls this after arming the ring; a cold scan compile inside a
+        request's ring iteration would show up as a multi-second p99
+        spike).  All-zero blocks are inactive no-ops — the table is
+        untouched, only the sequence word advances."""
+        resps = None
+        for tb in self._backend._tiers:
+            for t in self._tiers:
+                qs = np.zeros((t, 12, tb), dtype=np.int64)
+                nows = np.zeros(t, dtype=np.int64)
+                resps, self._seq_dev = self._backend.ring_step_dispatch(
+                    qs, nows, self._seq_dev
+                )
+                self.seq += t
+        if resps is not None:
+            np.asarray(resps)  # sync the last warmup block
+
+    # -- runner side ------------------------------------------------------
+    def _take_block_locked(self) -> Optional[List[_Job]]:
+        """Pop the next FIFO unit: a host job alone, or every queued
+        rounds-job up to the slot capacity as one block.  Caller holds
+        `_cond`."""
+        if not self._queue:
+            return None
+        if self._queue[0].fn is not None:
+            return [self._queue.popleft()]
+        block: List[_Job] = []
+        taken = 0
+        while self._queue and self._queue[0].fn is None:
+            n = int(self._queue[0].qs.shape[0])
+            if block and taken + n > self.slots:
+                break
+            block.append(self._queue.popleft())
+            taken += n
+        self._pending_rounds -= taken
+        self._cond.notify_all()  # wake producers blocked on capacity
+        return block
+
+    def _dispatch_block(self, block: List[_Job]):
+        """Assemble a jobs-block into one [tier, 12, B] request-ring
+        array and dispatch the jitted scan (the backend serializes
+        against every other table mutation under its own lock).  Returns
+        the fetch token (block, device responses, seq handle, expected
+        seq, t0)."""
+        be = self._backend
+        k = sum(int(job.qs.shape[0]) for job in block)
+        tier = ring_tier_of(k, self._tiers)
+        tb = max(int(job.qs.shape[2]) for job in block)
+        qs = np.zeros((tier, 12, tb), dtype=np.int64)
+        off_q = 0
+        for job in block:
+            jk, _, jtb = job.qs.shape
+            # Narrower jobs pad with zero lanes (inactive by layout).
+            qs[off_q:off_q + jk, :, :jtb] = job.qs
+            off_q += jk
+        now = np.int64(be.clock.millisecond_now())
+        nows = np.full(tier, now, dtype=np.int64)
+        t0 = time.monotonic()
+        if self._last_dispatch is not None:
+            self.loop_lag_s = t0 - self._last_dispatch
+            m = self._metrics
+            if m is not None:
+                m.fastpath_ring_loop_lag.set(self.loop_lag_s)
+        self._last_dispatch = t0
+        resps, seq_out = be.ring_step_dispatch(qs, nows, self._seq_dev)
+        self._seq_dev = seq_out
+        self.iterations += 1
+        self.rounds_consumed += k
+        self.padded_rounds += tier - k
+        self.seq += tier
+        if k > self.max_block:
+            self.max_block = k
+        m = self._metrics
+        if m is not None:
+            m.fastpath_ring_occupancy.observe(k)
+        # seq_out rides the token so the fetch reads THIS iteration's
+        # device word even after the next iteration dispatches with it.
+        return (block, resps, seq_out, self.seq, t0)
+
+    def _fetch_publish(self, token) -> None:
+        """The response-ring side: ONE packed transfer for the whole
+        iteration (responses + sequence word), then per-job publication.
+        Runs only on the runner thread — never on the request path."""
+        from gubernator_tpu.runtime.backend import (
+            _packed_resp_dict,
+            fetch_ravel,
+        )
+
+        block, resps, seq_dev, want_seq, t0 = token
+        try:
+            host, seq_host = fetch_ravel([resps, seq_dev])
+        except Exception as e:  # noqa: BLE001 — device fault: break ring
+            self._mark_broken()
+            for job in block:
+                job.publish(error=e)
+            return
+        if int(seq_host) != want_seq:
+            # The device loop and the host mirror disagree — responses
+            # may be misattributed.  Record loudly; the differential
+            # suite asserts this never fires.
+            self.seq_mismatches += 1
+        off = 0
+        for job in block:
+            n = int(job.qs.shape[0])
+            job.publish(result=[
+                _packed_resp_dict(host[off + i]) for i in range(n)
+            ])
+            off += n
+        m = self._metrics
+        fr = getattr(m, "flightrec", None) if m is not None else None
+        if fr is not None:
+            fr.record_batch(
+                off, (time.monotonic() - t0) * 1e3, kind="ring_iter"
+            )
+
+    def _mark_broken(self) -> None:
+        with self._cond:
+            self.broken = True
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        inflight = None  # dispatched, responses not yet fetched
+        while True:
+            with self._cond:
+                while (
+                    not self._queue
+                    and not self._closed
+                    and inflight is None
+                ):
+                    self._cond.wait()
+                if self._closed and not self._queue and inflight is None:
+                    return
+                unit = self._take_block_locked()
+                closing = self._closed
+            if unit is None:
+                # Idle (or draining at close) with an iteration in
+                # flight: fetch and publish it now.
+                self._fetch_publish(inflight)
+                inflight = None
+                continue
+            if unit[0].fn is not None:
+                # Host job: drain the pending fetch first (its buffers
+                # are a cheap sync away; the job may hold the backend
+                # lock for a while), then run the job verbatim.
+                if inflight is not None:
+                    self._fetch_publish(inflight)
+                    inflight = None
+                job = unit[0]
+                self.host_jobs += 1
+                try:
+                    job.publish(result=job.fn())
+                except BaseException as e:  # noqa: BLE001 — fail the job
+                    job.publish(error=e)
+                continue
+            if closing:
+                # Close raced in after these jobs queued: device effects
+                # have NOT happened yet for this unit — fail it rather
+                # than mutate state behind a closing daemon.
+                for job in unit:
+                    job.publish(error=RingClosedError("ring closed"))
+                continue
+            try:
+                token = self._dispatch_block(unit)
+            except BaseException as e:  # noqa: BLE001 — break the ring
+                self._mark_broken()
+                for job in unit:
+                    job.publish(error=e)
+                continue
+            # Double buffer: the PREVIOUS iteration's fetch overlaps this
+            # one's device execution.
+            if inflight is not None:
+                self._fetch_publish(inflight)
+            inflight = token
+
+    def close(self) -> None:
+        """Stop the runner: the in-flight iteration is fetched and
+        published (its device effects already landed); queued-but-never-
+        dispatched jobs fail with RingClosedError."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._runner.join(timeout=30.0)
+        # Belt and braces: anything the runner left behind must resolve.
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._pending_rounds = 0
+        for job in leftovers:
+            if not job.event.is_set():
+                job.publish(error=RingClosedError("ring closed"))

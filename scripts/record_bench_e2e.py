@@ -49,10 +49,19 @@ if not results:
     )
     raise SystemExit("no results parsed")
 
+# Platform honesty: take the ACTUAL jax platform from bench_e2e's own
+# summary line — a CPU run must never masquerade as the TPU rig.
+_summary_platform = next(
+    (r.get("platform") for r in results if r.get("config") == "summary"),
+    None,
+)
 artifact = {
     "round": ROUND,
     "harness": f"bench_e2e.py --seconds {SECONDS} --concurrency {CONC}",
-    "platform": "tpu (single chip via axon tunnel)",
+    "platform": (
+        "tpu (single chip via axon tunnel)"
+        if _summary_platform == "tpu" else (_summary_platform or "unknown")
+    ),
     "note": (
         "E2E daemon service path: gRPC wire -> compiled fast lane (C++ "
         "parse/pack/serialize) -> device step -> wire.  The rig's cost "
@@ -83,7 +92,14 @@ artifact = {
         "the global_4peer cluster run against this rig's ONE device, so "
         "the measured global/exact ratio includes cross-daemon device-"
         "queue interleave that a chip-per-daemon deployment does not "
-        "pay.  Tunnel throughput varies +-30% run to run."
+        "pay.  Tunnel throughput varies +-30% run to run.  Round-6 "
+        "addition: the serve_sweep_* configs A/B the three drain "
+        "disciplines (GUBER_SERVE_MODE=classic|pipelined|ring; "
+        "docs/ring.md) and the budget/serve_sweep_stages lines carry "
+        "blocking_fetches_per_check — the ring acceptance criterion is "
+        "that ring mode's steady-state blocking device->host fetches on "
+        "the request path are ZERO (readbacks move to the ring runner) "
+        "with small-batch p50 at or below the pipelined baseline."
     ),
     "results": results,
 }

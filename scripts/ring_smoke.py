@@ -1,0 +1,229 @@
+"""Ring-mode CPU smoke: ring vs classic result equivalence + zero
+request-path fetches.
+
+Drives ~10k mixed checks (token/leaky, bursts, RESET_REMAINING, valid
+Gregorian, zero/negative hits, duplicate keys, a GLOBAL slice with
+per-key-constant params) through the compiled fast lane twice — once at
+GUBER_SERVE_MODE=classic (the strict depth-1 drain) and once in ring
+mode — under a frozen clock, with concurrent workers owning disjoint
+key spaces so every key's history is deterministic regardless of merge
+composition.  Pass criteria (ISSUE 6 acceptance):
+
+  1. responses and final table rows bit-identical across modes;
+  2. the ring run performed ZERO blocking device->host fetches on the
+     request path (the machinery counter the classic run increments on
+     every merge);
+  3. the ring actually served (iterations > 0) and the sequence word
+     never disagreed with the host mirror.
+
+On failure the armed flight recorder's ring is dumped to
+ring-smoke-dumps/ for the CI artifact.  Runs in the CI matrix
+(JAX_PLATFORMS=cpu); exit 0 = pass.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_WORKERS = 6
+BATCHES_PER_WORKER = 24
+KEYS_PER_WORKER = 8  # k0..k5 exact mix, k6..k7 GLOBAL constant-param
+
+
+def build_schedules():
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    rng = random.Random(1234)
+    schedules = []
+    total = 0
+    for w in range(N_WORKERS):
+        payloads = []
+        for _ in range(BATCHES_PER_WORKER):
+            reqs = []
+            for _ in range(rng.randrange(40, 90)):
+                if rng.random() < 0.20:
+                    # GLOBAL slice: per-key-constant params (a flush-
+                    # time re-read of changed params would inject
+                    # schedule noise — see test_ring_mode_differential).
+                    k = 6 + rng.randrange(2)
+                    reqs.append(pb.RateLimitReq(
+                        name=f"rsmoke{w}",
+                        unique_key=f"k{k}",
+                        hits=rng.choice([0, 1, 1, 2]),
+                        limit=200 + 100 * (k % 2),
+                        duration=60_000,
+                        algorithm=k % 2,
+                        behavior=2,  # GLOBAL
+                        burst=250 if k % 2 == 0 else 0,
+                    ))
+                    continue
+                behavior = 0
+                duration = rng.choice([60_000, 60_000, 1_000])
+                if rng.random() < 0.06:
+                    behavior |= 8  # RESET_REMAINING
+                if rng.random() < 0.04:
+                    behavior |= 4  # DURATION_IS_GREGORIAN
+                    duration = rng.choice([1, 4])
+                reqs.append(pb.RateLimitReq(
+                    name=f"rsmoke{w}",
+                    unique_key=f"k{rng.randrange(6)}",
+                    hits=rng.choice([0, 1, 1, 1, 2, 5, -1]),
+                    limit=rng.choice([50, 200, 1000]),
+                    duration=duration,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 60]),
+                ))
+            total += len(reqs)
+            payloads.append(
+                pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+            )
+        schedules.append(payloads)
+    return schedules, total
+
+
+def run_mode(mode: str, schedules, clock):
+    from gubernator_tpu.core.config import Config, DeviceConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.flightrec import FlightRecorder
+    from gubernator_tpu.runtime.metrics import Metrics
+    from gubernator_tpu.runtime.service import Service
+
+    dev = DeviceConfig(num_slots=1 << 14, ways=8, batch_size=512)
+
+    async def scenario():
+        metrics = Metrics()
+        fr = FlightRecorder(metrics=metrics, dump_dir="ring-smoke-dumps")
+        metrics.flightrec = fr
+        fr.start()
+        svc = Service(Config(device=dev), clock=clock, metrics=metrics)
+        await svc.start()
+        fp = FastPath(svc, serve_mode=mode, ring_slots=8)
+        results: dict = {}
+
+        async def worker(w: int):
+            await asyncio.sleep(w * 0.002)
+            got = []
+            for payload in schedules[w]:
+                raw = await fp.check_raw(payload, peer_rpc=False)
+                assert raw is not None, "fast lane fell back"
+                got.append([
+                    (r.status, r.limit, r.remaining, r.reset_time, r.error)
+                    for r in pb.GetRateLimitsResp.FromString(raw).responses
+                ])
+            results[w] = got
+
+        await asyncio.gather(*(worker(w) for w in range(N_WORKERS)))
+        rows = {}
+        for w in range(N_WORKERS):
+            for k in range(KEYS_PER_WORKER):
+                key = f"rsmoke{w}_k{k}"
+                item = svc.backend.get_cache_item(key)
+                rows[key] = (
+                    (item.remaining, item.expire_at, int(item.status),
+                     item.limit, item.duration, int(item.algorithm))
+                    if item is not None else None
+                )
+        dv = fp.debug_vars()
+        snap = fr.snapshot()
+        await fp.close()
+        await svc.close()
+        await fr.close()
+        return results, rows, dv, snap
+
+    return asyncio.run(scenario())
+
+
+def main() -> int:
+    from gubernator_tpu import native
+    from gubernator_tpu.core import clock as clock_mod
+
+    if not native.available():
+        print("ring_smoke: SKIP (native library unavailable)")
+        return 0
+
+    schedules, total = build_schedules()
+    print(f"ring_smoke: {total} checks x 2 serve modes")
+    clock_mod.freeze()
+    try:
+        base_results, base_rows, base_dv, base_snap = run_mode(
+            "classic", schedules, clock_mod.default_clock()
+        )
+        ring_results, ring_rows, ring_dv, ring_snap = run_mode(
+            "ring", schedules, clock_mod.default_clock()
+        )
+    finally:
+        clock_mod.unfreeze()
+
+    ok = True
+    if ring_results != base_results:
+        for w in base_results:
+            for i, (a, b) in enumerate(
+                zip(base_results[w], ring_results[w])
+            ):
+                if a != b:
+                    print(
+                        f"FAIL: worker {w} batch {i} diverged:\n"
+                        f"  classic: {a[:3]}...\n  ring: {b[:3]}..."
+                    )
+                    break
+        ok = False
+    if ring_rows != base_rows:
+        diff = {
+            k for k in base_rows if base_rows[k] != ring_rows.get(k)
+        }
+        print(f"FAIL: {len(diff)} table rows diverged: {sorted(diff)[:5]}")
+        ok = False
+    ring_stats = ring_dv.get("ring", {})
+    blocking = ring_dv["blocking_fetches"]
+    per_check = (
+        sum(blocking.values()) / float(total) if total else 0.0
+    )
+    if sum(blocking.values()) != 0:
+        print(
+            "FAIL: ring mode performed blocking request-path fetches: "
+            f"{blocking} ({per_check:.4f} per check; must be 0)"
+        )
+        ok = False
+    if base_dv["blocking_fetches"]["mach"] == 0:
+        print("FAIL: classic run counted no machinery fetches — the "
+              "smoke's counter is broken/vacuous")
+        ok = False
+    if ring_stats.get("iterations", 0) < 1:
+        print(f"FAIL: the ring never iterated: {ring_stats}")
+        ok = False
+    if ring_stats.get("seq_mismatches", 0) != 0:
+        print(f"FAIL: sequence-word mismatches: {ring_stats}")
+        ok = False
+    print("ring_smoke: classic stats "
+          + json.dumps(base_dv["blocking_fetches"]))
+    print("ring_smoke: ring stats " + json.dumps(ring_stats))
+    if ok:
+        print(
+            f"ring_smoke: OK — {total} checks bit-identical across serve "
+            f"modes; ring ran {ring_stats.get('iterations')} iterations "
+            f"+ {ring_stats.get('host_jobs')} host jobs with 0 blocking "
+            "request-path fetches"
+        )
+    else:
+        # Dump both runs' flight-recorder rings for the CI artifact.
+        os.makedirs("ring-smoke-dumps", exist_ok=True)
+        with open("ring-smoke-dumps/ring_smoke_failure.json", "w") as f:
+            json.dump({
+                "classic": {"debug_vars": base_dv, "flightrec": base_snap},
+                "ring": {"debug_vars": ring_dv, "flightrec": ring_snap},
+            }, f, indent=1, default=str)
+        print("ring_smoke: FAILED (see ring-smoke-dumps/)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

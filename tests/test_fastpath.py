@@ -378,13 +378,16 @@ def test_sparse_overlap_drains():
     concurrent small batches, every response stays correct (each key's
     decrement sequence is exact), and close() during traffic neither
     hangs nor orphans waiters."""
-    conf = DaemonConfig(fastpath_sparse=64)
+    # Depth 1 pins the r5-exact configuration: the sparse slot is the
+    # ONLY overlap mechanism, so any drain arriving while the single
+    # fetch slot is busy is overlap-eligible.
+    conf = DaemonConfig(fastpath_sparse=64, pipeline_depth=1)
     c = Cluster.start(1, conf_template=conf)
     try:
         fp = _fp(c)
         assert fp._mach._sparse_limit == 64
 
-        async def hammer():
+        async def hammer(rounds_done: int):
             from gubernator_tpu.client import AsyncV1Client
 
             cl = AsyncV1Client(c.addresses()[0])
@@ -407,10 +410,19 @@ def test_sparse_overlap_drains():
                              limit=1_000_000, duration=60_000)
                 for i in range(8)
             ])
-            assert [r.remaining for r in rs] == [1_000_000 - 30] * 8
+            want = 1_000_000 - 30 * rounds_done
+            assert [r.remaining for r in rs] == [want] * 8
             await cl.close()
 
-        c.run(hammer(), timeout=120)
+        # Whether an overlap drain triggers depends on client wakeups
+        # de-synchronizing against in-flight fetches — guaranteed in the
+        # limit but racy per round (a loaded host can lock-step one
+        # hammer round into strictly serial merges).  Correctness is
+        # asserted EVERY round; only the scheduling property retries.
+        for rnd in range(1, 5):
+            c.run(hammer(rnd), timeout=120)
+            if fp._mach.overlap_drains > 0:
+                break
         assert fp._mach.drains > 0
         assert fp._mach.overlap_drains > 0, (
             "overlap slot never used: drains=%d waited=%d"

@@ -1,0 +1,258 @@
+"""The ring drain discipline (ops/ring.py + runtime/ring.py).
+
+Unit-level coverage of the device-resident serving loop: the bounded
+multi-round scan matches the round-at-a-time classic dispatch
+bit-for-bit, the sequence word is monotone and never disagrees with the
+host mirror, a full request ring blocks producers (backpressure) without
+losing work, close()-mid-flight resolves every outstanding slot, and the
+serve-mode plumbing validates/falls back per docs/ring.md.  The e2e
+bit-identity run (mixed GLOBAL/store workloads through the compiled fast
+lane) lives in tests/test_differential.py::test_ring_mode_differential;
+scripts/ring_smoke.py drives the 10k-check CI smoke.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.config import (
+    Config,
+    DeviceConfig,
+    normalize_serve_mode,
+)
+from gubernator_tpu.core.types import Algorithm, RateLimitReq
+from gubernator_tpu.ops.batch import pack_requests
+from gubernator_tpu.runtime.backend import DeviceBackend
+from gubernator_tpu.runtime.ring import RingBackend, RingClosedError
+
+DEV = DeviceConfig(num_slots=2048, ways=8, batch_size=64)
+
+
+def _reqs(step: int, n: int = 10):
+    return [
+        RateLimitReq(
+            name="ring",
+            unique_key=f"k{(step * 3 + i) % 7}",
+            hits=1 + (i % 2),
+            limit=40,
+            duration=60_000,
+            algorithm=(
+                Algorithm.LEAKY_BUCKET if i % 3 == 0
+                else Algorithm.TOKEN_BUCKET
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _rounds(reqs, clock):
+    return pack_requests(reqs, DEV.batch_size, clock).rounds
+
+
+def test_ring_matches_classic_dispatch(frozen_clock):
+    """The bounded scan applies stacked rounds exactly like the classic
+    round-at-a-time loop: every response column bit-identical, and the
+    sequence word strictly monotone with zero host/device mismatches."""
+    classic = DeviceBackend(DEV, clock=frozen_clock)
+    ringed = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=4)
+    try:
+        seqs = [ring.seq]
+        for step in range(6):
+            reqs = _reqs(step)
+            want = classic.step_rounds(
+                _rounds(reqs, frozen_clock), add_tally=False
+            )
+            got = ring.submit_rounds(_rounds(reqs, frozen_clock))()
+            assert len(got) == len(want)
+            for wh, gh in zip(want, got):
+                for col in ("status", "limit", "remaining", "reset_time",
+                            "stored", "stored_status", "found"):
+                    w = wh[col]
+                    np.testing.assert_array_equal(
+                        w, gh[col][..., : w.shape[-1]], err_msg=col
+                    )
+            seqs.append(ring.seq)
+            frozen_clock.advance(250)
+    finally:
+        ring.close()
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert ring.seq_mismatches == 0
+    assert ring.rounds_consumed >= 6
+
+
+def test_ring_host_jobs_fifo_with_iterations(frozen_clock):
+    """submit_host runs on the runner thread, FIFO with ring
+    iterations — a host job queued between two blocks observes the
+    first block's table mutations."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=4)
+    try:
+        ring.submit_rounds(
+            _rounds([RateLimitReq(name="ring", unique_key="h",
+                                  hits=3, limit=10, duration=60_000)],
+                    frozen_clock)
+        )
+        seen = ring.submit_host(
+            lambda: be.get_cache_item("ring_h").remaining
+        )()
+        assert seen == 7
+        assert ring.host_jobs == 1
+    finally:
+        ring.close()
+
+
+def test_full_ring_backpressure(frozen_clock):
+    """More queued rounds than slots: producers block (the slot-wait
+    path) but nothing is lost — every submission completes once the
+    runner drains, and the wait is accounted."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2)
+    gate = threading.Event()
+    try:
+        # Stall the runner in a host job so submissions pile up.
+        ring.submit_host(gate.wait)
+        waits = []
+        done = []
+
+        def producer(i: int):
+            w = ring.submit_rounds(_rounds(_reqs(i, n=4), frozen_clock))
+            waits.append(w)
+
+        threads = [
+            threading.Thread(target=producer, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        # With 2 slots and the runner stalled, at most 2 single-round
+        # submissions fit; the rest are blocked in submit_q.
+        assert sum(t.is_alive() for t in threads) >= 2
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        for w in waits:
+            done.append(w())
+        assert len(done) == 4 and all(len(r) == 1 for r in done)
+        assert ring.slot_waits >= 1
+        assert ring.slot_wait_s > 0.0
+    finally:
+        gate.set()
+        ring.close()
+
+
+def test_close_mid_flight(frozen_clock):
+    """close() while jobs are queued behind a stalled runner: the
+    in-flight host job finishes; never-dispatched round jobs fail with
+    RingClosedError; new submissions fail fast; nothing hangs."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2)
+    gate = threading.Event()
+    inflight = ring.submit_host(lambda: (gate.wait(), "done")[1])
+    queued = ring.submit_rounds(_rounds(_reqs(0, n=2), frozen_clock))
+
+    closer = threading.Thread(target=ring.close)
+    closer.start()
+    time.sleep(0.1)
+    gate.set()
+    closer.join(timeout=10)
+    assert not closer.is_alive()
+    assert inflight() == "done"
+    with pytest.raises(RingClosedError):
+        queued()
+    with pytest.raises(RingClosedError):
+        ring.submit_rounds(_rounds(_reqs(1, n=1), frozen_clock))
+    assert not ring.available()
+
+
+def test_serve_mode_validation():
+    assert normalize_serve_mode("") == "pipelined"
+    assert normalize_serve_mode(" Ring ") == "ring"
+    with pytest.raises(ValueError, match="serve mode"):
+        normalize_serve_mode("warp")
+    with pytest.raises(ValueError, match="ring slots"):
+        RingBackend(DeviceBackend(DEV), slots=0)
+
+
+def test_ring_env_knobs(monkeypatch):
+    from gubernator_tpu.core.config import (
+        ring_slots_from_env,
+        serve_mode_from_env,
+        setup_daemon_config,
+    )
+
+    monkeypatch.setenv("GUBER_SERVE_MODE", "ring")
+    monkeypatch.setenv("GUBER_RING_SLOTS", "16")
+    assert serve_mode_from_env() == "ring"
+    assert ring_slots_from_env() == 16
+    conf = setup_daemon_config()
+    assert conf.serve_mode == "ring" and conf.ring_slots == 16
+
+    # Nonsensical values must be rejected AT STARTUP, not deep in a
+    # constructor (the GUBER_PIPELINE_DEPTH discipline).
+    monkeypatch.setenv("GUBER_RING_SLOTS", "0")
+    with pytest.raises(ValueError, match="GUBER_RING_SLOTS"):
+        setup_daemon_config()
+    monkeypatch.setenv("GUBER_RING_SLOTS", "4096")
+    with pytest.raises(ValueError, match="GUBER_RING_SLOTS"):
+        setup_daemon_config()
+    monkeypatch.setenv("GUBER_RING_SLOTS", "8")
+    monkeypatch.setenv("GUBER_SERVE_MODE", "turbo")
+    with pytest.raises(ValueError, match="serve mode"):
+        setup_daemon_config()
+
+
+def test_mesh_backend_has_no_ring():
+    from gubernator_tpu.parallel.sharded import MeshBackend
+
+    assert DeviceBackend(DEV).ring_supported()
+    mesh_cfg = DeviceConfig(
+        num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+    )
+    assert not MeshBackend(mesh_cfg).ring_supported()
+    with pytest.raises(ValueError, match="does not support"):
+        RingBackend(MeshBackend(mesh_cfg))
+
+
+def test_fastpath_ring_fallback_modes(frozen_clock):
+    """serve_mode plumbing on FastPath: classic forces depth 1; ring on
+    a mesh service degrades to pipelined (the docs/ring.md fallback
+    rule); ring on a single-table service arms a RingBackend; a BROKEN
+    ring drops merges back to the pipelined path per merge."""
+    import asyncio
+
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+
+    async def scenario():
+        svc = Service(Config(device=DEV), clock=frozen_clock)
+        await svc.start()
+        fp = FastPath(svc, serve_mode="classic")
+        assert fp.pipeline_depth == 1 and fp._ring is None
+        await fp.close()
+
+        fp = FastPath(svc, serve_mode="ring", ring_slots=2)
+        assert fp.effective_serve_mode == "ring"
+        assert fp._ring is not None
+        fp._ring.broken = True  # simulate a device fault
+        assert fp._ring_live() is None  # merges take the pipelined path
+        await fp.close()
+        await svc.close()
+
+        mesh_cfg = DeviceConfig(
+            num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
+        )
+        svc = Service(Config(device=mesh_cfg), clock=frozen_clock)
+        await svc.start()
+        fp = FastPath(svc, serve_mode="ring")
+        assert fp.serve_mode == "ring"
+        assert fp.effective_serve_mode == "pipelined"
+        assert fp._ring is None
+        await fp.close()
+        await svc.close()
+
+    asyncio.run(scenario())

@@ -25,6 +25,10 @@ from tools.gubguard.core import Checker, Finding, ModuleInfo, dotted_name
 ALLOWED_SUFFIXES = (
     "runtime/backend.py",
     "runtime/fastpath.py",
+    # The ring runner thread IS the fetch side of the response ring —
+    # the one place ring-mode device->host syncs are supposed to live
+    # (docs/ring.md; the request path stays fetch-free).
+    "runtime/ring.py",
     "runtime/checkpoint.py",
     "runtime/sketch_backend.py",
     "runtime/store.py",

@@ -23,6 +23,7 @@ WATCHED_MODULES = (
     "gubernator_tpu/ops/step.py",
     "gubernator_tpu/ops/sketch.py",
     "gubernator_tpu/ops/pallas/cms_kernel.py",
+    "gubernator_tpu/ops/ring.py",
 )
 
 

@@ -167,6 +167,49 @@ _APPLY_COUNTERS = _TABLE_COUNTERS + _BATCH_COUNTERS + (".limit",
 _APPLY_Q_CASTS = {"to_f64": 11, "to_i32": 1}
 
 
+def _ring_spec() -> KernelSpec:
+    """ops/ring.py ring_step: the ring discipline's bounded multi-round
+    scan (docs/ring.md).  The scan body is apply_batch_packed_q traced
+    once, so the int64 counter taint propagates through the lax.scan
+    carry and the licensed casts are exactly the q-form step's (11
+    to_f64 leaky float sites + 1 to_i32 algo narrowing); the sequence
+    word is tainted int64 arithmetic with no cast.  Only the table is
+    donated — the seq word's output buffer must survive the next
+    iteration's dispatch (the double-buffered response protocol spins
+    on it), so donating it would be a correctness bug, not a win."""
+
+    def build() -> BuiltKernel:
+        import gubernator_tpu.ops.ring as ring_mod
+
+        def sig(k: int):
+            return lambda: (
+                _table(),
+                np.zeros((k, 12, 64), np.int64),
+                np.zeros(k, np.int64),
+                np.zeros((), np.int64),
+            )
+
+        return BuiltKernel(
+            fn=ring_mod.ring_step,
+            trace_fn=functools.partial(ring_mod.ring_step_impl, ways=WAYS),
+            signatures={"k1": sig(1), "k2": sig(2)},
+            counters=_TABLE_COUNTERS + ("[1]", "[2]", "[3]"),
+            allowed_casts=dict(_APPLY_Q_CASTS),
+            perturbations={
+                # Caller-mistake replay: a python-int seq traces weak.
+                "weak-seq": lambda: (
+                    _table(), np.zeros((1, 12, 64), np.int64),
+                    np.zeros(1, np.int64), 0,
+                ),
+            },
+            recompile_budget=3,
+            expect_aliased=12,  # table only — seq deliberately kept
+        )
+
+    return KernelSpec(name="ring_step", where="gubernator_tpu/ops/ring.py",
+                      build=build)
+
+
 def _sketch_state():
     from gubernator_tpu.ops.sketch import init_sketch
 
@@ -468,6 +511,8 @@ def specs() -> List[KernelSpec]:
             _TABLE_COUNTERS + ("[1]", "[2]"),
             dict(_APPLY_Q_CASTS), donated=12,
         ),
+        # -- ops/ring.py: the ring-fed device loop ----------------------
+        _ring_spec(),
         # -- ops/sketch.py + the fused Pallas form ----------------------
         _sketch_spec("cms_step_onehot", "cms_step_onehot",
                      "cms_step_impl"),
