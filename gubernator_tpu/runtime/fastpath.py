@@ -1889,9 +1889,15 @@ class FastPath:
                 try:
                     wait_rounds = ring.submit_q(ring_qs)
                 except RingClosedError:
-                    # Broke/closed between the check and the submit:
-                    # rebuild DeviceBatch rounds and take the pipelined
-                    # path below (rare; the ring never reopens).
+                    # Broke/closed between the check and the submit,
+                    # with NOTHING enqueued: rebuild DeviceBatch rounds
+                    # and take the pipelined path below (rare; the ring
+                    # never reopens).  A multi-chunk submit that loses
+                    # the ring part-way raises PartialSubmitError
+                    # instead — deliberately NOT caught here: the
+                    # queued chunks' device effects may already have
+                    # landed, so re-dispatching would double-apply
+                    # them; the error propagates and fails the merge.
                     rounds, order, bounds = _build_rounds(
                         values, rnd, lane, sh_all, n_rounds, n_shards, B
                     )

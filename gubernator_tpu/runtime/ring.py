@@ -58,9 +58,10 @@ class _Job:
     already in ring slot layout) or `fn` (a host job run verbatim on the
     runner thread)."""
 
-    __slots__ = ("qs", "fn", "event", "result", "error")
+    __slots__ = ("ring", "qs", "fn", "event", "result", "error")
 
-    def __init__(self, qs=None, fn=None) -> None:
+    def __init__(self, ring: "RingBackend", qs=None, fn=None) -> None:
+        self.ring = ring
         self.qs = qs
         self.fn = fn
         self.event = threading.Event()
@@ -73,7 +74,26 @@ class _Job:
         self.event.set()
 
     def wait(self):
-        self.event.wait()
+        """Bounded wait: a wedged runner (e.g. a host job stuck on a
+        slow Store call) must not hang waiters forever — that would
+        wedge the coalescer fetch stages and with them FastPath.close().
+        Two escapes: the ring turned defunct (close() gave up on the
+        runner) with this job unresolved, or the per-job timeout
+        expired, in which case the ring is marked broken so every later
+        merge falls back to the pipelined discipline."""
+        ring = self.ring
+        deadline = time.monotonic() + ring.job_timeout_s
+        while not self.event.wait(timeout=0.5):
+            if ring.defunct:
+                raise RingClosedError(
+                    "ring shut down with this job unresolved"
+                )
+            if time.monotonic() >= deadline:
+                ring._mark_broken()
+                raise RingClosedError(
+                    f"ring job timed out after {ring.job_timeout_s:.0f}s"
+                    " (runner wedged?)"
+                )
         if self.error is not None:
             raise self.error
         return self.result
@@ -83,10 +103,27 @@ class RingClosedError(RuntimeError):
     pass
 
 
+class PartialSubmitError(RuntimeError):
+    """A multi-chunk submit_q lost the ring after at least one chunk was
+    already queued — and possibly dispatched, i.e. its device effects
+    may have landed.  Deliberately NOT a RingClosedError subclass:
+    callers handle THAT by falling back to another drain path and
+    re-dispatching the merge, which here would apply the queued chunks'
+    hits twice.  The only safe handling is to fail the merge."""
+
+
 class RingBackend:
     """Request/response rings + the persistent device-loop runner."""
 
-    def __init__(self, backend, slots: int = 8, metrics=None) -> None:
+    # Ceiling on one job's wait for its published result — a liveness
+    # backstop against a wedged runner, far above any legitimate
+    # iteration or host-job latency (see _Job.wait).
+    JOB_TIMEOUT_S = 120.0
+
+    def __init__(
+        self, backend, slots: int = 8, metrics=None,
+        job_timeout_s: float = JOB_TIMEOUT_S,
+    ) -> None:
         if slots < 1:
             raise ValueError(f"ring slots must be >= 1, got {slots}")
         if not getattr(backend, "ring_supported", lambda: False)():
@@ -103,6 +140,11 @@ class RingBackend:
         self._pending_rounds = 0  # queued, not yet taken by the runner
         self._closed = False
         self.broken = False
+        # True once close() has drained/failed everything it can reach:
+        # any still-unresolved job can never resolve, so its waiters
+        # stop spinning (see _Job.wait).
+        self.defunct = False
+        self.job_timeout_s = job_timeout_s
         # Host mirror of the device sequence word (ops/ring.py): advances
         # by the consumed TIER (padding slots included) per iteration;
         # the fetch verifies the device word agrees.
@@ -159,15 +201,31 @@ class RingBackend:
         the FIFO queue + the in-order scan keep the rounds' effects
         sequential across chunk boundaries, and the machinery lane's
         serialized dispatch stage keeps other merges from interleaving
-        mid-merge submissions out of order."""
+        mid-merge submissions out of order.
+
+        Raises RingClosedError only while NOTHING has been enqueued
+        (safe for the caller to fall back and re-dispatch elsewhere);
+        losing the ring between chunks raises PartialSubmitError — the
+        queued chunks' device effects may already have landed, so the
+        caller must fail the merge instead."""
         n = int(qs.shape[0])
         if n == 0:
             return lambda: []
         if n > self.slots:
-            waits = [
-                self._submit_chunk(qs[lo:lo + self.slots])
-                for lo in range(0, n, self.slots)
-            ]
+            n_chunks = -(-n // self.slots)
+            waits = []
+            for lo in range(0, n, self.slots):
+                try:
+                    waits.append(self._submit_chunk(qs[lo:lo + self.slots]))
+                except RingClosedError as e:
+                    if not waits:
+                        raise
+                    raise PartialSubmitError(
+                        f"ring rejected chunk {len(waits) + 1}/{n_chunks}"
+                        f" with {len(waits)} chunks already queued; "
+                        "their device effects may have landed — fail "
+                        "the merge, do not re-dispatch it"
+                    ) from e
 
             def wait_all() -> list:
                 out: list = []
@@ -180,7 +238,7 @@ class RingBackend:
 
     def _submit_chunk(self, qs: np.ndarray) -> Callable[[], list]:
         n = int(qs.shape[0])
-        job = _Job(qs=qs)
+        job = _Job(self, qs=qs)
         t0 = time.monotonic()
         waited = False
         with self._cond:
@@ -213,7 +271,7 @@ class RingBackend:
         the ring iterations; returns a zero-arg wait for fn's result.
         Host jobs occupy no ring slots — their device work is their
         own."""
-        job = _Job(fn=fn)
+        job = _Job(self, fn=fn)
         with self._cond:
             if self._closed or self.broken:
                 raise RingClosedError(
@@ -345,8 +403,16 @@ class RingBackend:
         off = 0
         for job in block:
             n = int(job.qs.shape[0])
+            # Slice each job's rows back to ITS OWN batch tier: the
+            # block dispatched at the max tier across coalesced jobs,
+            # but the submitter's active masks and lane indices are
+            # built at the job's tier (tally_from_rounds would
+            # broadcast-fail on wider rows; the padded lanes are
+            # inactive by construction, so nothing real is dropped).
+            w = int(job.qs.shape[2])
             job.publish(result=[
-                _packed_resp_dict(host[off + i]) for i in range(n)
+                _packed_resp_dict(host[off + i][..., :w])
+                for i in range(n)
             ])
             off += n
         m = self._metrics
@@ -374,12 +440,27 @@ class RingBackend:
                 if self._closed and not self._queue and inflight is None:
                     return
                 unit = self._take_block_locked()
-                closing = self._closed
+                dead = self._closed or self.broken
+                dead_msg = "ring closed" if self._closed else "ring broken"
             if unit is None:
                 # Idle (or draining at close) with an iteration in
                 # flight: fetch and publish it now.
                 self._fetch_publish(inflight)
                 inflight = None
+                continue
+            if dead:
+                # Close/break raced in after these jobs queued: their
+                # effects have NOT happened yet (host jobs never ran,
+                # rounds never dispatched) — fail them uniformly
+                # rather than execute behind a closing daemon or
+                # dispatch against a backend that just faulted.  The
+                # in-flight iteration's effects DID land, so it is
+                # still fetched and published first.
+                if inflight is not None:
+                    self._fetch_publish(inflight)
+                    inflight = None
+                for job in unit:
+                    job.publish(error=RingClosedError(dead_msg))
                 continue
             if unit[0].fn is not None:
                 # Host job: drain the pending fetch first (its buffers
@@ -394,13 +475,6 @@ class RingBackend:
                     job.publish(result=job.fn())
                 except BaseException as e:  # noqa: BLE001 — fail the job
                     job.publish(error=e)
-                continue
-            if closing:
-                # Close raced in after these jobs queued: device effects
-                # have NOT happened yet for this unit — fail it rather
-                # than mutate state behind a closing daemon.
-                for job in unit:
-                    job.publish(error=RingClosedError("ring closed"))
                 continue
             try:
                 token = self._dispatch_block(unit)
@@ -418,7 +492,7 @@ class RingBackend:
     def close(self) -> None:
         """Stop the runner: the in-flight iteration is fetched and
         published (its device effects already landed); queued-but-never-
-        dispatched jobs fail with RingClosedError."""
+        started jobs — host jobs included — fail with RingClosedError."""
         with self._cond:
             if self._closed:
                 return
@@ -433,3 +507,10 @@ class RingBackend:
         for job in leftovers:
             if not job.event.is_set():
                 job.publish(error=RingClosedError("ring closed"))
+        if self._runner.is_alive():
+            # Join timed out: the runner is wedged inside a job it
+            # already popped.  Mark broken so nothing new is accepted;
+            # `defunct` below makes that job's waiters stop spinning
+            # (bounded _Job.wait) instead of hanging shutdown.
+            self._mark_broken()
+        self.defunct = True

@@ -26,9 +26,18 @@ from gubernator_tpu.core.config import (
 from gubernator_tpu.core.types import Algorithm, RateLimitReq
 from gubernator_tpu.ops.batch import pack_requests
 from gubernator_tpu.runtime.backend import DeviceBackend
-from gubernator_tpu.runtime.ring import RingBackend, RingClosedError
+from gubernator_tpu.runtime.ring import (
+    PartialSubmitError,
+    RingBackend,
+    RingClosedError,
+)
 
 DEV = DeviceConfig(num_slots=2048, ways=8, batch_size=64)
+# Two compiled batch tiers, so coalesced merges can pack at different
+# widths (DEV alone resolves to the single 64 tier).
+TIERED_DEV = DeviceConfig(
+    num_slots=2048, ways=8, batch_size=64, batch_tiers=(8, 64)
+)
 
 
 def _reqs(step: int, n: int = 10):
@@ -140,6 +149,166 @@ def test_full_ring_backpressure(frozen_clock):
         assert len(done) == 4 and all(len(r) == 1 for r in done)
         assert ring.slot_waits >= 1
         assert ring.slot_wait_s > 0.0
+    finally:
+        gate.set()
+        ring.close()
+
+
+def _uniq_reqs(tag: str, n: int):
+    return [
+        RateLimitReq(name="ring", unique_key=f"{tag}{i}", hits=1,
+                     limit=40, duration=60_000)
+        for i in range(n)
+    ]
+
+
+def test_mixed_tier_merges_coalesce(frozen_clock):
+    """Two merges packed at DIFFERENT batch tiers landing in one ring
+    block (the normal case under concurrent traffic): each merge's
+    published responses come back at ITS OWN tier — so its narrower
+    active masks combine with them without numpy broadcast errors —
+    and every column stays bit-identical to the classic dispatch."""
+    classic = DeviceBackend(TIERED_DEV, clock=frozen_clock)
+    ringed = DeviceBackend(TIERED_DEV, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=4)
+    gate = threading.Event()
+    try:
+        ring.submit_host(gate.wait)  # stall so both merges coalesce
+        small = pack_requests(_uniq_reqs("s", 2), 64, frozen_clock).rounds
+        big = pack_requests(_uniq_reqs("b", 40), 64, frozen_clock).rounds
+        w_small = ring.submit_rounds(small)
+        w_big = ring.submit_rounds(big)
+        gate.set()
+        got_small, got_big = w_small(), w_big()
+    finally:
+        gate.set()
+        ring.close()
+    assert ring.iterations == 1 and ring.max_block == 2
+    # Each merge's rows at its own tier, not the block's max tier.
+    assert got_small[0]["status"].shape[-1] == 8
+    assert got_big[0]["status"].shape[-1] == 64
+    # The exact expression that broadcast-failed pre-fix (the
+    # tally_from_rounds shape): narrow mask against published status.
+    act = np.asarray(small[0].active)[:8]
+    assert int(((got_small[0]["status"] == 1) & act).sum()) == 0
+    for reqs, got in ((_uniq_reqs("s", 2), got_small),
+                      (_uniq_reqs("b", 40), got_big)):
+        want = classic.step_rounds(
+            pack_requests(reqs, 64, frozen_clock).rounds, add_tally=False
+        )
+        assert len(want) == len(got)
+        for wh, gh in zip(want, got):
+            for col in ("status", "limit", "remaining", "reset_time",
+                        "stored", "stored_status", "found"):
+                w = wh[col]
+                np.testing.assert_array_equal(
+                    w, gh[col][..., : w.shape[-1]], err_msg=col
+                )
+
+
+def test_partial_submit_raises_distinct_error(frozen_clock):
+    """A merge wider than the ring that loses the ring between chunks:
+    the already-queued chunks' device effects may have landed, so
+    submit_q raises PartialSubmitError — NOT a RingClosedError, which
+    callers treat as safe-to-redispatch (that would double-apply)."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2)
+    gate = threading.Event()
+    errs = []
+    try:
+        from gubernator_tpu.runtime.backend import pack_batch_q, tier_of
+
+        ring.submit_host(gate.wait)  # wedge the runner
+        dup = [
+            RateLimitReq(name="ring", unique_key="dup", hits=1,
+                         limit=40, duration=60_000)
+            for _ in range(4)
+        ]
+        rounds = _rounds(dup, frozen_clock)  # 4 sequential rounds
+        tb = max(tier_of(db.active, be._tiers) for db in rounds)
+        qs = np.stack([pack_batch_q(db)[:, :tb] for db in rounds])
+        assert qs.shape[0] > ring.slots  # forces the chunked path
+
+        def producer():
+            try:
+                ring.submit_q(qs)
+            except BaseException as e:  # noqa: BLE001 — capture it
+                errs.append(e)
+
+        t = threading.Thread(target=producer)
+        t.start()
+        # Chunk 1 queues; chunk 2 blocks on capacity.  Break the ring
+        # out from under it.
+        time.sleep(0.3)
+        ring._mark_broken()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    finally:
+        gate.set()
+        ring.close()
+    assert len(errs) == 1
+    assert isinstance(errs[0], PartialSubmitError)
+    assert not isinstance(errs[0], RingClosedError)
+
+
+def test_queued_host_job_fails_after_close(frozen_clock):
+    """close()'s contract applies to host jobs too: one still queued
+    when close() begins never runs — it fails with RingClosedError
+    instead of executing verbatim behind a closing daemon."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2)
+    gate = threading.Event()
+    started = ring.submit_host(lambda: (gate.wait(), "ran")[1])
+    time.sleep(0.1)  # let the runner pop (and block inside) job 1
+    ran = []
+    queued = ring.submit_host(lambda: ran.append(True))
+    closer = threading.Thread(target=ring.close)
+    closer.start()
+    time.sleep(0.1)
+    gate.set()
+    closer.join(timeout=10)
+    assert not closer.is_alive()
+    assert started() == "ran"
+    with pytest.raises(RingClosedError):
+        queued()
+    assert not ran
+    assert ring.defunct
+
+
+def test_broken_ring_fails_queued_rounds(frozen_clock):
+    """After a fault marks the ring broken, the runner fails
+    still-queued rounds blocks instead of dispatching them against the
+    backend that just faulted."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=4)
+    gate = threading.Event()
+    try:
+        ring.submit_host(gate.wait)
+        w = ring.submit_rounds(_rounds(_reqs(0), frozen_clock))
+        time.sleep(0.1)
+        ring._mark_broken()
+        gate.set()
+        with pytest.raises(RingClosedError, match="broken"):
+            w()
+        assert ring.iterations == 0
+    finally:
+        gate.set()
+        ring.close()
+
+
+def test_job_wait_timeout_breaks_ring(frozen_clock):
+    """A wedged runner must not hang waiters (and through them,
+    FastPath.close()) forever: waits are bounded by job_timeout_s,
+    raise RingClosedError, and mark the ring broken so later merges
+    fall back to the pipelined discipline."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2, job_timeout_s=1.0)
+    gate = threading.Event()
+    try:
+        stuck = ring.submit_host(lambda: (gate.wait(), "late")[1])
+        with pytest.raises(RingClosedError, match="timed out"):
+            stuck()
+        assert ring.broken and not ring.available()
     finally:
         gate.set()
         ring.close()
