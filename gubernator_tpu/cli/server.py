@@ -29,11 +29,29 @@ def main() -> None:
         level=conf.log_level,
         fmt=os.environ.get("GUBER_LOG_FORMAT", "text"),
     )
-    # OTel tracing from standard OTEL_* env vars (cmd/gubernator/main.go
-    # initializes its tracer the same way, main.go:56-69).
+    # Tracing from standard OTEL_* env vars (cmd/gubernator/main.go
+    # initializes its tracer the same way, main.go:56-69).  The status
+    # is logged HONESTLY: a configured OTLP endpoint whose exporter
+    # packages are missing says so instead of pretending spans export
+    # (the old bool return hid exactly that failure).
     from gubernator_tpu.runtime.tracing import init_tracing
 
-    init_tracing()
+    trace_log = logging.getLogger("gubernator_tpu.tracing")
+    status = init_tracing()
+    if status.enabled:
+        if status.exporter_error:
+            trace_log.warning(
+                "tracing armed (sampler=%s) but NOT exporting: %s — "
+                "spans stay in-process (breach dumps, /debug/vars)",
+                status.sampler, status.exporter_error,
+            )
+        else:
+            trace_log.info(
+                "tracing armed: sampler=%s exporter=%s",
+                status.sampler, status.exporter,
+            )
+    else:
+        trace_log.info("tracing disabled: %s", status.reason)
 
     async def run() -> None:
         daemon = Daemon(conf)

@@ -26,6 +26,7 @@ from gubernator_tpu.net.netutil import resolve_host_ip
 from gubernator_tpu.net.tls import TLSBundle, setup_tls
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2
+from gubernator_tpu.runtime import tracing
 from gubernator_tpu.runtime.metrics import Metrics
 from gubernator_tpu.runtime.service import ApiError, Service
 
@@ -38,6 +39,44 @@ _GRPC_CODES = {
 }
 
 
+class _TracingInterceptor(grpc.aio.ServerInterceptor):
+    """Server-side w3c context extract: every unary RPC runs inside an
+    `rpc.server` span whose parent is the caller's `traceparent`
+    metadata (a forwarding daemon or a traced client), so one trace
+    spans a multi-daemon cluster.  Listed FIRST so the stats
+    interceptor's SLO observation (and its exemplar) runs with the
+    request's trace context still bound.  When tracing is disarmed the
+    handler is returned untouched — zero per-RPC overhead."""
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if (
+            handler is None
+            or handler.unary_unary is None
+            or not tracing.enabled()
+        ):
+            return handler
+        method = handler_call_details.method
+        parent = None
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == "traceparent":
+                parent = tracing.parse_traceparent(value)
+                break
+        inner = handler.unary_unary
+
+        async def wrapped(request, context):
+            with tracing.span(
+                "rpc.server", parent=parent, **{"rpc.method": method}
+            ):
+                return await inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
 class _StatsInterceptor(grpc.aio.ServerInterceptor):
     """Per-RPC count + duration + failed for EVERY server method — the
     analog of the reference's grpc.StatsHandler, which tags each RPC and
@@ -47,33 +86,45 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
     def __init__(self, metrics: Metrics) -> None:
         self.metrics = metrics
 
+    async def _observed_call(self, inner, method, request, context):
+        m = self.metrics
+        start = time.monotonic()
+        failed = "false"
+        try:
+            return await inner(request, context)
+        except BaseException:
+            failed = "true"
+            raise
+        finally:
+            dur = time.monotonic() - start
+            m.grpc_request_counts.labels(
+                method=method, failed=failed
+            ).inc()
+            # The SLO histogram records the serving request's trace id
+            # as an OpenMetrics exemplar when the request is sampled —
+            # a scrape's p99 bucket then names a trace to pull
+            # (rendered by the openmetrics exposition; docs/tracing.md).
+            ctx = tracing.current_context()
+            tid = ctx.trace_id_hex() if ctx and ctx.sampled else None
+            m.grpc_request_duration.labels(method=method).observe(
+                dur, {"trace_id": tid} if tid else None
+            )
+            fr = m.flightrec
+            if fr is not None:
+                # Every RPC feeds the rolling SLO window (the p99 the
+                # north star is stated against is request latency); the
+                # trace id makes a breach dump name its slow traces.
+                fr.observe_request(dur, trace_id=tid)
+
     async def intercept_service(self, continuation, handler_call_details):
         handler = await continuation(handler_call_details)
         if handler is None or handler.unary_unary is None:
             return handler
         method = handler_call_details.method
         inner = handler.unary_unary
-        m = self.metrics
 
         async def wrapped(request, context):
-            start = time.monotonic()
-            failed = "false"
-            try:
-                return await inner(request, context)
-            except BaseException:
-                failed = "true"
-                raise
-            finally:
-                dur = time.monotonic() - start
-                m.grpc_request_counts.labels(
-                    method=method, failed=failed
-                ).inc()
-                m.grpc_request_duration.labels(method=method).observe(dur)
-                fr = m.flightrec
-                if fr is not None:
-                    # Every RPC feeds the rolling SLO window (the p99 the
-                    # north star is stated against is request latency).
-                    fr.observe_request(dur)
+            return await self._observed_call(inner, method, request, context)
 
         return grpc.unary_unary_rpc_method_handler(
             wrapped,
@@ -278,7 +329,10 @@ class Daemon:
         # 4MB recv cap: grpc-go's default, which reference peers assume.
         # Count-capped peer batches (batch_limit=1000) with long key strings
         # can pass 1MB, and a rejected batch fails every flush window.
-        interceptors = [_StatsInterceptor(self.metrics)]
+        interceptors = [
+            _TracingInterceptor(),
+            _StatsInterceptor(self.metrics),
+        ]
         if self.chaos is not None:
             from gubernator_tpu.testing.chaos import ChaosServerInterceptor
 
@@ -496,6 +550,25 @@ class Daemon:
                     self.metrics.circuit_state.labels(
                         peerAddr=peer.info().grpc_address
                     ).set(int(peer.breaker.state))
+        # Tracing span counters (runtime/tracing.py is process-global;
+        # refreshed at scrape like the device gauges above).
+        tv = tracing.debug_vars()
+        for state, val in (tv.get("spans") or {}).items():
+            if state != "recent":
+                self.metrics.tracing_spans.labels(state=state).set(val)
+        accept = request.headers.get("Accept", "")
+        if "application/openmetrics-text" in accept:
+            # OpenMetrics exposition carries the trace-id exemplars the
+            # classic text format cannot represent (docs/tracing.md).
+            return web.Response(
+                body=self.metrics.render_openmetrics(),
+                headers={
+                    "Content-Type": (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                    )
+                },
+            )
         return web.Response(
             body=self.metrics.render(),
             content_type="text/plain",
@@ -564,6 +637,9 @@ class Daemon:
             # waited_drains, bubble_ms_total, occupancy) — the knobs an
             # operator reads when tuning GUBER_PIPELINE_DEPTH.
             out["fastpath"] = fp.debug_vars()
+        # Attribution plane (runtime/tracing.py): enabled, sampler,
+        # honest exporter status, spans started/exported/dropped.
+        out["tracing"] = tracing.debug_vars()
         fr = self.flightrec
         if fr is not None:
             out["flightrec"] = {

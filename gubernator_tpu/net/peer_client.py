@@ -35,6 +35,7 @@ from gubernator_tpu.core.types import (
 from gubernator_tpu.net import grpc_api
 from gubernator_tpu.net.breaker import CircuitBreaker, CircuitState
 from gubernator_tpu.proto import peers_pb2
+from gubernator_tpu.runtime import tracing
 
 ERROR_WINDOW_S = 300.0  # keep peer errors 5 min (peer_client.go:282)
 
@@ -387,18 +388,29 @@ class PeerClient:
             await self._connect()
             if self.breaker is not None and not self.breaker.allow():
                 raise self._shed("breaker_open")
-            try:
-                budget = await self._ensure_ready()
-                if self.chaos is not None:
-                    await self.chaos.on_client(
-                        self.peer_info.grpc_address, "GetPeerRateLimits"
+            # Cross-peer attribution: the client span covers the whole
+            # forward (readiness gate included) and its context rides
+            # the RPC as w3c `traceparent` metadata, so the owner
+            # daemon's server span joins this trace (docs/tracing.md).
+            with tracing.span(
+                "peer.forward", require_parent=True,
+                peer=self.peer_info.grpc_address,
+                method="GetPeerRateLimits",
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address,
+                            "GetPeerRateLimits",
+                        )
+                    out = await self._raw_get_peer_rate_limits(
+                        payload, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
                     )
-                out = await self._raw_get_peer_rate_limits(
-                    payload, timeout=budget
-                )
-            except asyncio.CancelledError:
-                self._record_cancelled("GetPeerRateLimits[raw]")
-                raise
+                except asyncio.CancelledError:
+                    self._record_cancelled("GetPeerRateLimits[raw]")
+                    raise
             self._record_success()
             return out
         except grpc.aio.AioRpcError as e:
@@ -423,19 +435,30 @@ class PeerClient:
             stub = await self._connect()
             if self.breaker is not None and not self.breaker.allow():
                 raise self._shed("breaker_open")
-            try:
-                budget = await self._ensure_ready()
-                if self.chaos is not None:
-                    await self.chaos.on_client(
-                        self.peer_info.grpc_address, "UpdatePeerGlobals"
+            with tracing.span(
+                "peer.broadcast", require_parent=True,
+                peer=self.peer_info.grpc_address,
+                method="UpdatePeerGlobals",
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address,
+                            "UpdatePeerGlobals",
+                        )
+                    req = peers_pb2.UpdatePeerGlobalsReq(
+                        globals=[
+                            grpc_api.global_to_pb(g) for g in globals_
+                        ]
                     )
-                req = peers_pb2.UpdatePeerGlobalsReq(
-                    globals=[grpc_api.global_to_pb(g) for g in globals_]
-                )
-                await stub.UpdatePeerGlobals(req, timeout=budget)
-            except asyncio.CancelledError:
-                self._record_cancelled("UpdatePeerGlobals")
-                raise
+                    await stub.UpdatePeerGlobals(
+                        req, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
+                    )
+                except asyncio.CancelledError:
+                    self._record_cancelled("UpdatePeerGlobals")
+                    raise
             self._record_success()
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
@@ -624,18 +647,26 @@ class PeerClient:
             # The RPC-issue gate: one batched send is one half-open
             # probe; anything past the probe budget sheds here.
             raise self._shed("breaker_open")
-        try:
-            budget = await self._ensure_ready()
-            if self.chaos is not None:
-                await self.chaos.on_client(
-                    self.peer_info.grpc_address, "GetPeerRateLimits"
+        with tracing.span(
+            "peer.forward", require_parent=True,
+            peer=self.peer_info.grpc_address,
+            method="GetPeerRateLimits",
+        ):
+            try:
+                budget = await self._ensure_ready()
+                if self.chaos is not None:
+                    await self.chaos.on_client(
+                        self.peer_info.grpc_address, "GetPeerRateLimits"
+                    )
+                pb_req = peers_pb2.GetPeerRateLimitsReq(
+                    requests=[grpc_api.req_to_pb(r) for r in reqs]
                 )
-            pb_req = peers_pb2.GetPeerRateLimitsReq(
-                requests=[grpc_api.req_to_pb(r) for r in reqs]
-            )
-            pb_resp = await stub.GetPeerRateLimits(pb_req, timeout=budget)
-        except asyncio.CancelledError:
-            self._record_cancelled("GetPeerRateLimits")
-            raise
+                pb_resp = await stub.GetPeerRateLimits(
+                    pb_req, timeout=budget,
+                    metadata=tracing.grpc_metadata(),
+                )
+            except asyncio.CancelledError:
+                self._record_cancelled("GetPeerRateLimits")
+                raise
         self._record_success()
         return [grpc_api.resp_from_pb(m) for m in pb_resp.rate_limits]

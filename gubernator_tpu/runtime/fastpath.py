@@ -67,6 +67,7 @@ import numpy as np
 
 from gubernator_tpu import native
 from gubernator_tpu.core.config import MAX_BATCH_SIZE
+from gubernator_tpu.runtime import tracing
 from gubernator_tpu.core.interval import (
     GregorianError,
     gregorian_duration,
@@ -213,6 +214,14 @@ class _Coalescer:
         if self._closed:
             raise RuntimeError("fastpath closed")
         entry.fut = asyncio.get_running_loop().create_future()
+        if tracing.enabled():
+            # Carry the request's trace context across the coalescer
+            # seam: the merge dispatch runs on a pool thread where the
+            # submitting task's contextvars are invisible.
+            try:
+                entry.trace_ctx = tracing.current_context()
+            except AttributeError:
+                pass  # foreign entry types (tests) without the slot
         if self._task is None:
             self._task = asyncio.ensure_future(self._run())
         await self._queue.put(entry)
@@ -315,17 +324,50 @@ class _Coalescer:
 
         return run_once
 
+    def _merge_span(self, entries):
+        """(merge span, stage parent ctx) for one drained entry list:
+        the span's parent is the first SAMPLED member's context and
+        every other member attaches as a span link — the merge is the
+        join point of N concurrent request traces, and the links are
+        what lets any member's trace find the shared device round.
+        (None, None) when tracing is off or no member carried a
+        context."""
+        if not tracing.enabled():
+            return None, None
+        ctxs = [
+            c for c in (getattr(e, "trace_ctx", None) for e in entries)
+            if c is not None
+        ]
+        if not ctxs:
+            return None, None
+        parent = next((c for c in ctxs if c.sampled), ctxs[0])
+        msp = tracing.start_span(
+            "fastpath.merge", parent,
+            links=[c for c in ctxs if c is not parent],
+            lane=self._lane, entries=len(entries),
+        )
+        if msp is not None:
+            msp.set_attribute(
+                "size", int(sum(self._size_of(e) for e in entries))
+            )
+        return msp, (msp.context if msp is not None else parent)
+
     async def _dispatch(self, loop, entries, fetch_sem) -> None:
         """One merge's pipeline: dispatch stage on a pool thread (holds
         the dispatch slot), then — if `process` returned a continuation —
         the fetch stage on another pool pass (holds only the fetch slot,
         so the next merge dispatches concurrently)."""
         fetch_fn = None
+        msp, stage_ctx = self._merge_span(entries)
         try:
             t0 = time.monotonic()
             try:
                 res = await loop.run_in_executor(
-                    self._pool, lambda: self._process(entries)
+                    self._pool,
+                    tracing.wrap(
+                        lambda: self._process(entries),
+                        "fastpath.dispatch", stage_ctx, lane=self._lane,
+                    ),
                 )
             finally:
                 # Dispatch stage over (or failed): the next merge may
@@ -335,7 +377,13 @@ class _Coalescer:
             if callable(res):
                 fetch_fn = self._once(res)
                 t0 = time.monotonic()
-                outs = await loop.run_in_executor(self._pool, fetch_fn)
+                outs = await loop.run_in_executor(
+                    self._pool,
+                    tracing.wrap(
+                        fetch_fn,
+                        "fastpath.fetch", stage_ctx, lane=self._lane,
+                    ),
+                )
                 self._note_stage("fetch", time.monotonic() - t0)
             else:
                 outs = res  # single-phase process
@@ -353,6 +401,8 @@ class _Coalescer:
                 # FastPath.close() joins the pool, so the side effects
                 # land before teardown.  The entries still fail below.
                 self._pool.submit(fetch_fn)
+            if msp is not None:
+                msp.end(error=repr(e))
             err = (
                 RuntimeError("fastpath closed")
                 if isinstance(e, asyncio.CancelledError) else e
@@ -369,6 +419,8 @@ class _Coalescer:
         finally:
             self.inflight -= 1
             fetch_sem.release()
+            if msp is not None:
+                msp.end()
 
     async def close(self) -> None:
         self._closed = True  # new do() calls fail fast, never respawn _run
@@ -2191,7 +2243,7 @@ class _Entry:
 
     __slots__ = (
         "payload", "cols", "is_greg", "greg_expire", "greg_duration",
-        "use_cached", "fut",
+        "use_cached", "fut", "trace_ctx",
     )
 
     def __init__(self, payload, cols, is_greg, greg_expire, greg_duration,
@@ -2203,24 +2255,29 @@ class _Entry:
         self.greg_duration = greg_duration
         self.use_cached = use_cached
         self.fut = None
+        self.trace_ctx = None
 
 
 class _SketchEntry:
     """Sketch-lane coalescer entry (fut assigned by _Coalescer.do)."""
 
-    __slots__ = ("kh", "hits", "limits", "fut")
+    __slots__ = ("kh", "hits", "limits", "fut", "trace_ctx")
 
     def __init__(self, kh, hits, limits):
         self.kh = kh
         self.hits = hits
         self.limits = limits
         self.fut = None
+        self.trace_ctx = None
 
 
 class _EngineEntry:
     """Engine-lane coalescer entry (fut assigned by _Coalescer.do)."""
 
-    __slots__ = ("payload", "cols", "idx", "is_greg", "ge", "gd", "fut")
+    __slots__ = (
+        "payload", "cols", "idx", "is_greg", "ge", "gd", "fut",
+        "trace_ctx",
+    )
 
     def __init__(self, payload, cols, idx, is_greg, ge, gd):
         self.payload = payload
@@ -2230,6 +2287,7 @@ class _EngineEntry:
         self.ge = ge
         self.gd = gd
         self.fut = None
+        self.trace_ctx = None
 
 
 def _build_rounds(values, rnd, lane, sh_all, n_rounds, n_shards, B):

@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
+from gubernator_tpu.runtime import tracing
+
 log = logging.getLogger("gubernator_tpu.flightrec")
 
 DEFAULT_SLO_P99_MS = 2.0  # BASELINE.json north star: p99 < 2ms
@@ -125,8 +127,19 @@ class FlightRecorder:
     # -- producers (any thread) ------------------------------------------
     def record(self, kind: str, **fields) -> None:
         """Append one record to the ring.  Called from the loop AND from
-        device-executor threads; must never block beyond the dict append."""
+        device-executor threads; must never block beyond the dict append.
+
+        When the producer runs inside a sampled trace (the span plane
+        binds its context on whichever thread executes a stage — the
+        coalescer's fetch stage, the ring runner, the event loop), the
+        record carries the trace/span ids, so a breach dump's ring can
+        be joined against the trace behind its p99 bucket."""
         rec = {"ts": time.time(), "kind": kind}
+        if tracing.enabled():
+            ctx = tracing.current_context()
+            if ctx is not None and ctx.sampled:
+                rec["trace_id"] = ctx.trace_id_hex()
+                rec["span_id"] = ctx.span_id_hex()
         rec.update(fields)
         with self._lock:
             self._ring.append(rec)
@@ -158,9 +171,13 @@ class FlightRecorder:
             "fastlane_bubble", lane=lane, wait_ms=round(wait_ms, 3)
         )
 
-    def observe_request(self, duration_s: float) -> None:
-        """One served request's latency into the rolling SLO window."""
-        self._lat.append((time.monotonic(), duration_s))
+    def observe_request(
+        self, duration_s: float, trace_id: Optional[str] = None
+    ) -> None:
+        """One served request's latency into the rolling SLO window;
+        `trace_id` (hex) tags the sample as an exemplar, so a breach
+        dump can name the slowest traces in its window."""
+        self._lat.append((time.monotonic(), duration_s, trace_id))
 
     def note_error(self, n: int = 1) -> None:
         now = time.monotonic()
@@ -171,9 +188,25 @@ class FlightRecorder:
     def percentiles(self) -> Tuple[float, float, int]:
         """(p50_ms, p99_ms, n) over the trailing window."""
         cutoff = time.monotonic() - self.window_s
-        window = [d for ts, d in list(self._lat) if ts >= cutoff]
+        window = [d for ts, d, _t in list(self._lat) if ts >= cutoff]
         p50, p99 = _quantiles(window)
         return p50 * 1e3, p99 * 1e3, len(window)
+
+    def slow_exemplars(self, limit: int = 8) -> List[Dict]:
+        """The slowest trace-tagged samples in the trailing window —
+        the OpenMetrics-exemplar view of the SLO histogram, readable
+        straight from a dump: each entry names a trace id an operator
+        (or trace_smoke) can pull from the span plane."""
+        cutoff = time.monotonic() - self.window_s
+        tagged = [
+            (d, t) for ts, d, t in list(self._lat)
+            if ts >= cutoff and t
+        ]
+        tagged.sort(reverse=True)
+        return [
+            {"ms": round(d * 1e3, 3), "trace_id": t}
+            for d, t in tagged[:limit]
+        ]
 
     def error_rate(self) -> int:
         cutoff = time.monotonic() - self.window_s
@@ -259,6 +292,7 @@ class FlightRecorder:
                 "samples": n,
                 "errors_in_window": self.error_rate(),
             },
+            "slow_exemplars": self.slow_exemplars(),
             "loop_lag_ms": {
                 "last": round(self.last_lag_ms, 2),
                 "max": round(self.max_lag_ms, 2),
@@ -278,6 +312,15 @@ class FlightRecorder:
             self.metrics.flightrec_dump_total.labels(reason=reason).inc()
         payload = self.snapshot()
         payload["reason"] = reason
+        # Trace-tagged dump: every trace id the window knows about —
+        # ring records tagged by the span plane, plus the slowest
+        # exemplars — pulls its full in-process span tree into the
+        # artifact, so the dump CONTAINS the trace behind the breach
+        # instead of merely naming it.
+        trace_ids = {
+            r["trace_id"] for r in payload["ring"] if "trace_id" in r
+        } | {e["trace_id"] for e in payload["slow_exemplars"]}
+        payload["traces"] = tracing.recent_spans_for(trace_ids)
         path = os.path.join(
             self.dump_dir,
             "flightrec-%d-%d-%s.json" % (os.getpid(), self.dumps, reason),

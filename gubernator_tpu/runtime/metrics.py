@@ -259,6 +259,15 @@ class Metrics:
             ["reason"],  # slo_breach | error_storm | signal | http
             registry=r,
         )
+        self.tracing_spans = Gauge(
+            "gubernator_tracing_spans",
+            "Tracing span counters (runtime/tracing.py) since process "
+            "start, refreshed at scrape: started (sampled spans "
+            "created), exported (handed to an exporter), dropped "
+            "(export failed).",
+            ["state"],  # started | exported | dropped
+            registry=r,
+        )
 
         # -- compiled fast lane: pipelined drain (runtime/fastpath.py) ----
         self.fastpath_drains = Counter(
@@ -353,3 +362,14 @@ class Metrics:
     def render(self) -> bytes:
         """Text exposition for the /metrics endpoint."""
         return generate_latest(self.registry)
+
+    def render_openmetrics(self) -> bytes:
+        """OpenMetrics exposition — the format that renders the
+        trace-id exemplars the SLO histograms record (the classic text
+        format silently omits them).  Served by /metrics when the
+        scraper's Accept header asks for it."""
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as om_generate_latest,
+        )
+
+        return om_generate_latest(self.registry)

@@ -51,14 +51,21 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from gubernator_tpu.ops.ring import resolve_ring_tiers, ring_tier_of
+from gubernator_tpu.runtime import tracing
+from gubernator_tpu.runtime.tracing import device_step_annotation
 
 
 class _Job:
     """One submitted unit: either `qs` (an int64[k, 12, B] request block
     already in ring slot layout) or `fn` (a host job run verbatim on the
-    runner thread)."""
+    runner thread).  `trace_ctx` is the submitter's trace context,
+    carried explicitly because the runner is a plain thread — ring
+    iterations and host jobs re-attach to the request's trace through
+    it."""
 
-    __slots__ = ("ring", "qs", "fn", "event", "result", "error")
+    __slots__ = (
+        "ring", "qs", "fn", "event", "result", "error", "trace_ctx",
+    )
 
     def __init__(self, ring: "RingBackend", qs=None, fn=None) -> None:
         self.ring = ring
@@ -67,6 +74,7 @@ class _Job:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.trace_ctx = tracing.current_context()
 
     def publish(self, result=None, error=None) -> None:
         self.result = result
@@ -356,6 +364,20 @@ class RingBackend:
             off_q += jk
         now = np.int64(be.clock.millisecond_now())
         nows = np.full(tier, now, dtype=np.int64)
+        # One iteration span per device round: parented on the first
+        # sampled job's context with every other job's context attached
+        # as a span link — a request's trace pins the exact ring
+        # iteration it rode, and the monotone sequence word (set below,
+        # once consumed) names the device round.
+        isp = None
+        if tracing.enabled():
+            ctxs = [j.trace_ctx for j in block if j.trace_ctx is not None]
+            if ctxs:
+                parent = next((c for c in ctxs if c.sampled), ctxs[0])
+                isp = tracing.start_span(
+                    "ring.iteration", parent,
+                    links=[c for c in ctxs if c is not parent],
+                )
         t0 = time.monotonic()
         if self._last_dispatch is not None:
             self.loop_lag_s = t0 - self._last_dispatch
@@ -363,7 +385,15 @@ class RingBackend:
             if m is not None:
                 m.fastpath_ring_loop_lag.set(self.loop_lag_s)
         self._last_dispatch = t0
-        resps, seq_out = be.ring_step_dispatch(qs, nows, self._seq_dev)
+        # The profiler annotation makes ring rounds visible in
+        # jax.profiler captures exactly like classic dispatches
+        # (runtime/backend.py wraps its step loop the same way), so the
+        # ring loop-lag gauges line up with the device timeline.
+        with tracing.use_context(isp.context if isp is not None else None):
+            with device_step_annotation("gubernator_ring_step"):
+                resps, seq_out = be.ring_step_dispatch(
+                    qs, nows, self._seq_dev
+                )
         self._seq_dev = seq_out
         self.iterations += 1
         self.rounds_consumed += k
@@ -371,23 +401,47 @@ class RingBackend:
         self.seq += tier
         if k > self.max_block:
             self.max_block = k
+        if isp is not None:
+            isp.set_attribute("ring.seq", self.seq)
+            isp.set_attribute("ring.rounds", k)
+            isp.set_attribute("ring.tier", tier)
+            isp.end()
         m = self._metrics
         if m is not None:
             m.fastpath_ring_occupancy.observe(k)
         # seq_out rides the token so the fetch reads THIS iteration's
         # device word even after the next iteration dispatches with it.
-        return (block, resps, seq_out, self.seq, t0)
+        return (
+            block, resps, seq_out, self.seq, t0,
+            isp.context if isp is not None else None,
+        )
 
     def _fetch_publish(self, token) -> None:
         """The response-ring side: ONE packed transfer for the whole
         iteration (responses + sequence word), then per-job publication.
         Runs only on the runner thread — never on the request path."""
+        block, resps, seq_dev, want_seq, t0, it_ctx = token
+        fsp = tracing.start_span(
+            "ring.fetch_publish", it_ctx, **{"ring.seq": want_seq}
+        )
+        try:
+            with tracing.use_context(
+                fsp.context if fsp is not None else it_ctx
+            ):
+                self._fetch_publish_inner(block, resps, seq_dev,
+                                          want_seq, t0)
+        finally:
+            if fsp is not None:
+                fsp.end()
+
+    def _fetch_publish_inner(
+        self, block, resps, seq_dev, want_seq, t0
+    ) -> None:
         from gubernator_tpu.runtime.backend import (
             _packed_resp_dict,
             fetch_ravel,
         )
 
-        block, resps, seq_dev, want_seq, t0 = token
         try:
             host, seq_host = fetch_ravel([resps, seq_dev])
         except Exception as e:  # noqa: BLE001 — device fault: break ring
@@ -471,8 +525,15 @@ class RingBackend:
                     inflight = None
                 job = unit[0]
                 self.host_jobs += 1
+                # A FIFO host job re-attaches to its submitter's trace
+                # (locked cascade/store merges, sketch readbacks): the
+                # span brackets the whole runner-side execution, so a
+                # trace shows exactly how long the job held the runner.
+                run = tracing.wrap(
+                    job.fn, "ring.host_job", job.trace_ctx
+                )
                 try:
-                    job.publish(result=job.fn())
+                    job.publish(result=run())
                 except BaseException as e:  # noqa: BLE001 — fail the job
                     job.publish(error=e)
                 continue

@@ -1281,9 +1281,18 @@ class GlobalManager:
                         )
 
         # Fan out per peer — one slow peer must not delay the others.
-        await asyncio.gather(
-            *(flush_one(p, b) for p, b in by_peer.values())
-        )
+        # The flush is a trace ROOT (sampled per the configured root
+        # sampler): it aggregates many requests' queued hits, so there
+        # is no single request context to continue — but the peer RPCs
+        # under it still carry w3c traceparent, connecting the flush to
+        # the owner daemons' server spans.
+        with tracing.span(
+            "global.flush_hits", parent=None,
+            peers=len(by_peer), keys=len(hits),
+        ):
+            await asyncio.gather(
+                *(flush_one(p, b) for p, b in by_peer.values())
+            )
         self.s.metrics.async_durations.observe(time.monotonic() - start)
 
     async def _run_broadcasts(self) -> None:
@@ -1385,13 +1394,16 @@ class GlobalManager:
                 )
                 return False
 
-        results = await asyncio.gather(
-            *(
-                push_one(p)
-                for p in self.s.peer_list()
-                if not p.info().is_owner
+        with tracing.span(
+            "global.broadcast", parent=None, updates=len(globals_)
+        ):
+            results = await asyncio.gather(
+                *(
+                    push_one(p)
+                    for p in self.s.peer_list()
+                    if not p.info().is_owner
+                )
             )
-        )
         sent = any(results)
         if sent:
             self.broadcasts += 1

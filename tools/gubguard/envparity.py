@@ -21,6 +21,14 @@ Rules:
     inapplicable to the TPU rebuild;
   * parsed-but-undocumented (absent from deploy/example.conf) is a
     WARNING: every supported knob must be discoverable.
+
+OTEL_* is an ACKNOWLEDGED external namespace, not drift: it is the
+OpenTelemetry SDK's own env spec (runtime/tracing.py reads the subset
+it implements; an attached OTel SDK reads more).  Docs may therefore
+reference OTEL_ vars this repo never parses — only the
+parsed-but-undocumented warning applies to them (an OTEL_ var our code
+DOES read must still appear in deploy/example.conf).  The GUBER_*/
+GUBTRACE_* rules stay strict and unchanged.
 """
 from __future__ import annotations
 
@@ -32,6 +40,11 @@ from typing import Dict, Iterable, List, Set
 from tools.gubguard.core import Checker, Finding, ModuleInfo
 
 _VAR_RE = re.compile(r"\b(?:GUBER|GUBTRACE)_[A-Z0-9_]+\b")
+# The acknowledged external namespace: standard OpenTelemetry env vars
+# (see module docstring).  Tracked separately so example.conf coverage
+# of the vars we parse is still checked, but a documented-only OTEL_
+# var is never flagged as a silent no-op.
+_OTEL_RE = re.compile(r"\bOTEL_[A-Z0-9_]+\b")
 
 # The Go reference daemon's env surface (config.go:253-504).  Vars the
 # rebuild already parses are checked dynamically; this list exists so
@@ -85,6 +98,7 @@ class EnvParityChecker(Checker):
 
     def __init__(self) -> None:
         self.parsed: Set[str] = set()
+        self.parsed_otel: Set[str] = set()
         self.saw_config = False
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
@@ -95,6 +109,7 @@ class EnvParityChecker(Checker):
                 node.value, str
             ):
                 self.parsed.update(_VAR_RE.findall(node.value))
+                self.parsed_otel.update(_OTEL_RE.findall(node.value))
         return ()
 
     def finalize(self, root: Path) -> Iterable[Finding]:
@@ -149,11 +164,10 @@ class EnvParityChecker(Checker):
         conf = root / _EXAMPLE_CONF
         if conf.is_file():
             try:
-                doc_vars = set(
-                    _VAR_RE.findall(conf.read_text(encoding="utf-8"))
-                )
+                conf_text = conf.read_text(encoding="utf-8")
             except OSError:
-                doc_vars = set()
+                conf_text = ""
+            doc_vars = set(_VAR_RE.findall(conf_text))
             undocumented = sorted(
                 v for v in self.parsed - doc_vars if v != "GUBER_"
             )
@@ -164,6 +178,21 @@ class EnvParityChecker(Checker):
                     message=(
                         "parsed but absent from example.conf: "
                         + ", ".join(undocumented)
+                    ),
+                ))
+            # OTEL_* (acknowledged external namespace): only the vars
+            # runtime/tracing.py actually READS must be discoverable in
+            # example.conf — documented-only OTEL_ vars belong to the
+            # OTel SDK's spec and are never drift.
+            otel_doc = set(_OTEL_RE.findall(conf_text))
+            otel_missing = sorted(self.parsed_otel - otel_doc)
+            if otel_missing:
+                out.append(Finding(
+                    checker=self.name, path=_EXAMPLE_CONF, line=1,
+                    severity="warning",
+                    message=(
+                        "OTEL_ vars read by the runtime but absent "
+                        "from example.conf: " + ", ".join(otel_missing)
                     ),
                 ))
         return out

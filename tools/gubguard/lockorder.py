@@ -44,6 +44,8 @@ CLASS_LOCK_MAP = {
     ("Store", "_lock"): "store._lock",
     ("MockStore", "_lock"): "store._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
+    ("_TraceState", "_lock"): "tracing._lock",
+    ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
 }
 # receiver variable name -> canonical prefix
 VAR_ALIAS = {
@@ -81,6 +83,12 @@ RANK = {
     "sketch._lock": 40,
     "store._lock": 50,
     "flightrec._lock": 60,
+    # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
+    # flightrec: span bookkeeping may run under ANY layer's lock (a span
+    # ends inside a locked merge), and the tracing plane never takes
+    # another lock while holding its own (exports run outside it).
+    "tracing._lock": 70,
+    "tracing.exporter._lock": 71,
 }
 
 Site = Tuple[str, int]  # (relpath, line)
