@@ -105,6 +105,7 @@ def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
 def bench(seconds: float, concurrency: int,
           depth_sweep: Tuple[int, ...] = (1, 2, 4),
           serve_sweep: Tuple[str, ...] = ("classic", "pipelined", "ring"),
+          workload: str = "",
           ) -> None:
     """Sync driver: client coroutines run on each cluster's OWN loop —
     grpc.aio multiplexes one poller per process, and a second event loop
@@ -773,9 +774,77 @@ def bench(seconds: float, concurrency: int,
             "config": "cms_sketch_100m_space", "error": str(e)
         }))
 
+    # ---- --workload zipf:<s>: owner-skew on a 3-daemon cluster --------
+    # Production key popularity is zipfian, which funnels the hottest
+    # keys onto single ring owners (ROADMAP item 5 / docs/hotkeys.md).
+    # This config measures exactly that skew: seeded zipf draws from
+    # one client daemon, reported as the per-owner share of applied
+    # checks next to the usual latency percentiles — the baseline the
+    # hot-key survival plane's mirroring is judged against.
+    if workload:
+        try:
+            kind, _, arg = workload.partition(":")
+            if kind != "zipf":
+                raise ValueError(f"unknown workload {workload!r}; "
+                                 "expected zipf:<s>")
+            zs = float(arg or "1.2")
+            c = Cluster.start_with(
+                ["", "", ""], device=dev_cfg, conf_template=conf()
+            )
+            try:
+                from gubernator_tpu.testing.chaos import zipf_keys
+
+                universe = 100_000
+                draws = zipf_keys(7, zs, 64 * 1000, universe)
+                zpays = [
+                    build_payload([
+                        ("bench_skew", f"z{k}")
+                        for k in draws[j * 1000:(j + 1) * 1000]
+                    ], limit=1_000_000_000, duration=60_000)
+                    for j in range(64)
+                ]
+                addr = [c.daemons[0].grpc_address]
+                c.run(drive(addr, zpays, 1.0, concurrency), timeout=120)
+                before = {
+                    d.grpc_address: d.service.backend.checks
+                    for d in c.daemons
+                }
+                t0 = time.perf_counter()
+                rpcs, lat = c.run(
+                    drive(addr, zpays, seconds, concurrency), timeout=120
+                )
+                wall = time.perf_counter() - t0
+                after = {
+                    d.grpc_address: d.service.backend.checks
+                    for d in c.daemons
+                }
+                delta = {a: after[a] - before[a] for a in after}
+                total = max(sum(delta.values()), 1)
+                share = {
+                    a: round(v / total, 4) for a, v in delta.items()
+                }
+                # zipf rank 1 maps to index 0 (zipf_keys subtracts 1).
+                hot_owner = c.owner_daemon_of("bench_skew_z0")
+                emit(f"zipf_owner_skew_s{zs:g}", rpcs * 1000, rpcs, lat,
+                     wall, {
+                         "zipf_s": zs,
+                         "universe": universe,
+                         "per_owner_applied_share": share,
+                         "max_owner_share": max(share.values()),
+                         "hottest_key_owner": hot_owner.grpc_address,
+                     })
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate config failures
+            print(json.dumps({
+                "config": "zipf_owner_skew", "workload": workload,
+                "error": str(e),
+            }))
+
     summary = {
         "config": "summary",
         "platform": platform,
+        "workload": workload,
         "fastpath_sparse": sparse,
         "pipeline_depth": depth,
         "pipeline_depth_sweep": list(depth_sweep),
@@ -808,6 +877,13 @@ def main() -> None:
         "(empty disables); the ring entry reports the fetch-free "
         "budget split (docs/ring.md)",
     )
+    ap.add_argument(
+        "--workload", default="",
+        help="extra skewed-workload config: zipf:<s> drives seeded "
+        "zipfian key draws at a 3-daemon cluster and reports the "
+        "per-owner share of applied checks alongside p50/p99 "
+        "(docs/hotkeys.md; empty disables)",
+    )
     args = ap.parse_args()
     sweep = tuple(
         int(d) for d in args.pipeline_depth.split(",") if d.strip()
@@ -816,7 +892,7 @@ def main() -> None:
         m.strip() for m in args.serve_mode.split(",") if m.strip()
     )
     bench(args.seconds, args.concurrency, depth_sweep=sweep,
-          serve_sweep=modes)
+          serve_sweep=modes, workload=args.workload)
 
 
 if __name__ == "__main__":

@@ -102,6 +102,131 @@ def normalize_degraded_mode(value: str) -> str:
     return v
 
 
+@dataclass
+class HotKeyConfig:
+    """Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md; no
+    reference analog — the Go daemon funnels a zipfian workload's
+    hottest keys onto single owners until they melt).
+
+    Three coupled mechanisms, all gated on MEASURED owner pressure (the
+    flight recorder's rolling p99 vs GUBER_SLO_P99_MS) so that none of
+    them activates on a healthy cluster — naive always-on duplication
+    makes tails worse under load (arXiv:1909.08969):
+
+    * detection — every node tracks the per-key rate of the traffic it
+      routes in a host-side count-min sketch; a key whose pressure
+      score (estimated hits/s x owner SLO-pressure ratio) stays past
+      `threshold` for `promote_windows` consecutive windows joins a
+      small exact hot-set, leaving it after `demote_windows` windows
+      below (hysteresis: the set cannot flap at the threshold);
+    * mirroring — a hot key's owner-set widens to the next `mirrors`
+      distinct arcs of the existing ring (deterministic on every
+      peer); each mirror serves from a LOCAL allowance of
+      `fraction x limit` and reconciles its hits to the owner through
+      the GLOBAL async-hit machinery, bounding cluster-wide
+      over-admission to `limit x (1 + mirrors x fraction)` — the
+      local_shadow algebra with pressure (not death) as the gate;
+    * shedding — when this node's own p99 breach persists past
+      `shed_cooldown_s`, requests matching `shed_priorities` globs are
+      dropped with OVER_LIMIT + retry-after metadata, lowest priority
+      class first, escalating one class per further cooldown.
+    """
+
+    enabled: bool = True
+    # Promotion threshold on the pressure score: estimated hits/s for
+    # the key (this node's local view) x the owner's SLO-pressure
+    # ratio (p99 / target; 0 while the owner is healthy — so with no
+    # measured pressure NOTHING ever promotes).
+    threshold: float = 500.0
+    # Extra next-arc ring replicas a hot key's owner-set widens to
+    # while the owner is pressured.  0 disables widening entirely.
+    mirrors: int = 1
+    # Fraction of the limit each mirror may admit from its local slot.
+    fraction: float = 0.25
+    # Detection window length (seconds) — rates are estimated per
+    # window; promote/demote hysteresis counts these windows.
+    window_s: float = 1.0
+    promote_windows: int = 2
+    demote_windows: int = 3
+    # Hot-set capacity (exact entries; the sketch stays O(1) per key).
+    max_hot: int = 64
+    # How long an owner's advertised pressure (RPC trailing metadata)
+    # stays live on this node before decaying to 0.
+    pressure_ttl_s: float = 5.0
+    # p99 breach must persist this long before shedding arms; each
+    # further cooldown escalates one priority class.
+    shed_cooldown_s: float = 5.0
+    # fnmatch globs over limit NAMES, lowest-priority (shed first)
+    # first.  A name matching no glob is never shed.  Empty list =
+    # shedding disabled.
+    shed_priorities: List[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.threshold <= 0:
+            raise ValueError(
+                f"hotkey threshold must be > 0, got {self.threshold}"
+            )
+        if self.mirrors < 0:
+            raise ValueError(
+                f"hotkey mirrors must be >= 0, got {self.mirrors}"
+            )
+        if not 0.0 < self.fraction <= 1.0:
+            raise ValueError(
+                f"hotkey fraction must be in (0, 1], got {self.fraction}"
+            )
+        if self.window_s <= 0:
+            raise ValueError(
+                f"hotkey window_s must be > 0, got {self.window_s}"
+            )
+        for n, v in (
+            ("promote_windows", self.promote_windows),
+            ("demote_windows", self.demote_windows),
+            ("max_hot", self.max_hot),
+        ):
+            if v < 1:
+                raise ValueError(f"hotkey {n} must be >= 1, got {v}")
+        if self.pressure_ttl_s <= 0:
+            raise ValueError(
+                f"hotkey pressure_ttl_s must be > 0, "
+                f"got {self.pressure_ttl_s}"
+            )
+        if self.shed_cooldown_s <= 0:
+            raise ValueError(
+                f"hotkey shed_cooldown_s must be > 0, "
+                f"got {self.shed_cooldown_s}"
+            )
+
+
+def hotkey_config_from_env() -> HotKeyConfig:
+    """The hot-key plane's env parse, shared by the daemon and harnesses
+    (same contract as pipeline_depth_from_env): validation errors name
+    the env var at startup instead of crashing a constructor later."""
+    prios = [
+        p.strip()
+        for p in _env("GUBER_HOTKEY_SHED_PRIORITIES").split(",")
+        if p.strip()
+    ]
+    try:
+        return HotKeyConfig(
+            enabled=_env("GUBER_HOTKEY_ENABLED", "true").lower()
+            not in ("0", "false", "no"),
+            threshold=float(_env("GUBER_HOTKEY_THRESHOLD", "500")),
+            mirrors=_env_int("GUBER_HOTKEY_MIRRORS", 1),
+            fraction=float(_env("GUBER_HOTKEY_FRACTION", "0.25")),
+            window_s=_env_float_s("GUBER_HOTKEY_WINDOW", 1.0),
+            promote_windows=_env_int("GUBER_HOTKEY_PROMOTE_WINDOWS", 2),
+            demote_windows=_env_int("GUBER_HOTKEY_DEMOTE_WINDOWS", 3),
+            max_hot=_env_int("GUBER_HOTKEY_MAX", 64),
+            pressure_ttl_s=_env_float_s("GUBER_HOTKEY_PRESSURE_TTL", 5.0),
+            shed_cooldown_s=_env_float_s(
+                "GUBER_HOTKEY_SHED_COOLDOWN", 5.0
+            ),
+            shed_priorities=prios,
+        )
+    except ValueError as e:
+        raise ValueError(f"hot-key env config: {e}") from None
+
+
 # Fast-lane drain disciplines (runtime/fastpath.py; docs/ring.md):
 #   classic    — strict depth-1: every merge's dispatch AND fetch
 #                serialize end to end (the pre-PR5 discipline);
@@ -236,6 +361,8 @@ class Config:
     # shadow slot while the owner is gone (cluster-wide over-admission
     # is bounded by peers * shadow_fraction * limit).
     shadow_fraction: float = 0.5
+    # Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md).
+    hotkey: HotKeyConfig = field(default_factory=HotKeyConfig)
 
 
 @dataclass
@@ -332,6 +459,9 @@ class DaemonConfig:
     circuit: CircuitConfig = field(default_factory=CircuitConfig)
     degraded_mode: str = "error"  # see DEGRADED_MODES
     shadow_fraction: float = 0.5
+    # Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md):
+    # owner-pressure detection, bounded mirroring, SLO-driven shedding.
+    hotkey: HotKeyConfig = field(default_factory=HotKeyConfig)
     # Chaos plane (testing/chaos.py): a seeded fault plan injected at
     # the peer-client and daemon RPC boundaries.  `chaos_plan` is a JSON
     # plan file (empty = no chaos — the production default); `chaos`
@@ -631,6 +761,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             _env("GUBER_DEGRADED_MODE", "error")
         ),
         shadow_fraction=shadow_fraction,
+        hotkey=hotkey_config_from_env(),
         chaos_plan=_env("GUBER_CHAOS_PLAN", ""),
         chaos_seed=_env_int("GUBER_CHAOS_SEED", 0),
     )

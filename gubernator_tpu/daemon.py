@@ -23,6 +23,7 @@ from gubernator_tpu.core.config import Config, DaemonConfig
 from gubernator_tpu.core.types import PeerInfo
 from gubernator_tpu.net import grpc_api
 from gubernator_tpu.net.netutil import resolve_host_ip
+from gubernator_tpu.net.peer_client import PRESSURE_METADATA_KEY
 from gubernator_tpu.net.tls import TLSBundle, setup_tls
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2
@@ -91,7 +92,22 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
         start = time.monotonic()
         failed = "false"
         try:
-            return await inner(request, context)
+            out = await inner(request, context)
+            # Pressure advertisement (docs/hotkeys.md): while this
+            # daemon's rolling p99 breach run is unbroken, every answered
+            # RPC carries the ratio as trailing metadata so callers'
+            # PeerClients learn the owner is overloaded-but-alive —
+            # the signal that gates hot-key mirroring on their side.
+            fr = m.flightrec
+            if fr is not None and fr.pressure_active():
+                try:
+                    context.set_trailing_metadata((
+                        (PRESSURE_METADATA_KEY,
+                         "%.3f" % max(fr.pressure_ratio(), 1.0)),
+                    ))
+                except Exception:  # noqa: BLE001 — advisory only
+                    pass
+            return out
         except BaseException:
             failed = "true"
             raise
@@ -295,6 +311,7 @@ class Daemon:
             circuit=getattr(self.conf, "circuit", None) or Config().circuit,
             degraded_mode=getattr(self.conf, "degraded_mode", "error"),
             shadow_fraction=getattr(self.conf, "shadow_fraction", 0.5),
+            hotkey=getattr(self.conf, "hotkey", None) or Config().hotkey,
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -631,6 +648,26 @@ class Daemon:
                     addr: len(keys) for addr, keys in s._shadow.items()
                 },
             }
+            if s.hotkeys is not None:
+                # Hot-key survival plane (docs/hotkeys.md): the exact
+                # hot-set, this node's active mirror widenings, and the
+                # pressure-shed state.
+                s.hotkeys.poll()  # idle demotion isn't traffic-gated
+                out["hotkeys"] = {
+                    **s.hotkeys.debug_vars(),
+                    "mirror_served": s.mirror_served,
+                    "active_mirrors": [
+                        "%016x" % (int(fp) & 0xFFFFFFFFFFFFFFFF)
+                        for fp in s.active_mirror_fps()
+                    ],
+                    "shed": {
+                        "level": s.shed_level(),
+                        "served": s.shed_served,
+                        "priorities": list(
+                            s.cfg.hotkey.shed_priorities
+                        ),
+                    },
+                }
         fp = self.fastpath
         if fp is not None:
             # Per-lane drain/pipeline counters (drains, overlap_drains,

@@ -39,6 +39,14 @@ from gubernator_tpu.runtime import tracing
 
 ERROR_WINDOW_S = 300.0  # keep peer errors 5 min (peer_client.go:282)
 
+# Trailing-metadata key a pressured daemon stamps on its RPC responses
+# (daemon.py stats interceptor): the owner's rolling p99 over its SLO
+# target while its breach run is unbroken.  The cross-peer half of the
+# hot-key survival plane (docs/hotkeys.md): an overloaded-but-ALIVE
+# owner — answering RPCs, clean error window, breaker closed — is
+# otherwise indistinguishable from a healthy one.
+PRESSURE_METADATA_KEY = "x-guber-pressure"
+
 
 class PeerNotReadyError(RuntimeError):
     """Routing-layer retry signal: peer is shutting down or unreachable
@@ -109,6 +117,7 @@ class PeerClient:
         metrics=None,
         circuit: Optional[CircuitConfig] = None,
         chaos=None,
+        pressure_ttl_s: float = 5.0,
     ) -> None:
         self.peer_info = info
         self.metrics = metrics
@@ -152,6 +161,13 @@ class PeerClient:
         self._drained = asyncio.Event()
         self._drained.set()
         self._errors: Deque[Tuple[float, str]] = collections.deque(maxlen=100)
+        # Owner-pressure view (docs/hotkeys.md): (monotonic expiry,
+        # ratio) from the peer's latest x-guber-pressure trailing
+        # metadata; decays to 0 after `pressure_ttl_s` without a fresh
+        # advertisement, so a healed owner's widening collapses even if
+        # no further RPC flows.
+        self._pressure_ttl_s = pressure_ttl_s
+        self._pressure = (0.0, 0.0)
         # Structural unsent-classification state: has this channel EVER
         # reached READY?  Set by the `_ensure_ready` pre-dial gate (and
         # by any RPC completing).  While False, NO RPC has ever been
@@ -186,9 +202,47 @@ class PeerClient:
         return self.breaker is not None and self.breaker.fast_fail()
 
     def circuit_snapshot(self) -> dict:
-        if self.breaker is None:
-            return {"state": "disabled"}
-        return self.breaker.snapshot()
+        snap = (
+            {"state": "disabled"} if self.breaker is None
+            else self.breaker.snapshot()
+        )
+        # Overloaded-but-alive interplay (docs/hotkeys.md): a peer that
+        # answers RPCs but advertises an SLO breach must not read as
+        # fully healthy in /debug/vars circuits — the breaker has no
+        # failures to show, so the pressure view rides the snapshot.
+        ratio = self.pressure_ratio()
+        if ratio > 0.0:
+            snap["pressure"] = round(ratio, 3)
+        return snap
+
+    # -- owner pressure (docs/hotkeys.md) --------------------------------
+    def note_pressure(self, ratio: float) -> None:
+        """The peer advertised an SLO breach (ratio = its p99 over its
+        target); live for `pressure_ttl_s` from now."""
+        self._pressure = (time.monotonic() + self._pressure_ttl_s, ratio)
+
+    def pressure_ratio(self) -> float:
+        """Latest advertised pressure ratio, 0 once the TTL lapsed."""
+        deadline, ratio = self._pressure
+        return ratio if time.monotonic() < deadline else 0.0
+
+    def pressure_active(self) -> bool:
+        """True while the peer's advertised p99 is at/over its target —
+        the gate that activates hot-key mirroring toward this owner."""
+        return self.pressure_ratio() >= 1.0
+
+    def _note_pressure_md(self, md) -> None:
+        """Scan RPC trailing metadata for the pressure advertisement
+        (cheap: absent on healthy peers, one small pair otherwise)."""
+        if not md:
+            return
+        for key, value in md:
+            if key == PRESSURE_METADATA_KEY:
+                try:
+                    self.note_pressure(float(value))
+                except (TypeError, ValueError):
+                    pass
+                return
 
     def _on_circuit_transition(
         self, old: CircuitState, new: CircuitState
@@ -404,10 +458,12 @@ class PeerClient:
                             self.peer_info.grpc_address,
                             "GetPeerRateLimits",
                         )
-                    out = await self._raw_get_peer_rate_limits(
+                    call = self._raw_get_peer_rate_limits(
                         payload, timeout=budget,
                         metadata=tracing.grpc_metadata(),
                     )
+                    out = await call
+                    self._note_pressure_md(await call.trailing_metadata())
                 except asyncio.CancelledError:
                     self._record_cancelled("GetPeerRateLimits[raw]")
                     raise
@@ -661,10 +717,12 @@ class PeerClient:
                 pb_req = peers_pb2.GetPeerRateLimitsReq(
                     requests=[grpc_api.req_to_pb(r) for r in reqs]
                 )
-                pb_resp = await stub.GetPeerRateLimits(
+                call = stub.GetPeerRateLimits(
                     pb_req, timeout=budget,
                     metadata=tracing.grpc_metadata(),
                 )
+                pb_resp = await call
+                self._note_pressure_md(await call.trailing_metadata())
             except asyncio.CancelledError:
                 self._record_cancelled("GetPeerRateLimits")
                 raise

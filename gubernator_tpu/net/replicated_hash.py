@@ -142,6 +142,37 @@ class ReplicatedConsistentHash(Generic[P]):
             idx = 0
         return self._ring_peers[idx]
 
+    def get_n(self, key: str, n: int) -> List[P]:
+        """The key's owner plus the next distinct peers walking the
+        ring clockwise, at most `n` total — the next-N-arcs widened
+        owner-set for hot-key mirroring (docs/hotkeys.md).  Every peer
+        computes the identical list from the shared ring, so mirror
+        membership needs no coordination.  `out[0]` is always `get(key)`;
+        a pool smaller than `n` returns every peer, owner first."""
+        if not self._peers:
+            raise PoolEmptyError()
+        return self.get_n_hashed(self.hash_fn(key.encode()), n)
+
+    def get_n_hashed(self, h: int, n: int) -> List[P]:
+        """`get_n` from a precomputed ring hash — the fast lane's form
+        (an xx ring's hash IS the parser's XXH64 key fingerprint)."""
+        if not self._peers:
+            raise PoolEmptyError()
+        idx = bisect.bisect_left(self._ring_hashes, h)
+        total = len(self._ring_hashes)
+        out: List[P] = []
+        seen = set()
+        for k in range(total):
+            p = self._ring_peers[(idx + k) % total]
+            addr = self.key_of(p)
+            if addr in seen:
+                continue
+            seen.add(addr)
+            out.append(p)
+            if len(out) >= n or len(out) == len(self._peers):
+                break
+        return out
+
 
 class RegionPicker(Generic[P]):
     """One consistent-hash ring per datacenter (region_picker.go:23-111).

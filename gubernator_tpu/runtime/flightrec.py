@@ -120,6 +120,13 @@ class FlightRecorder:
         self.max_lag_ms = 0.0
         self.last_dump_path: Optional[str] = None
         self._last_dump_mono: float = -1e9
+        # Pressure signal (docs/hotkeys.md): monotonic timestamp of the
+        # first evaluation of the CURRENT unbroken run of p99 breaches,
+        # None while healthy.  Drives hot-key promotion scores, the
+        # owner's pressure advertisement on RPC trailing metadata
+        # (daemon.py), and SLO shedding (service.shed_level).
+        self._pressure_since: Optional[float] = None
+        self.pressure_events = 0
         self._profiling = False
         self._task: Optional[asyncio.Task] = None
         self._started_wall = time.time()
@@ -212,6 +219,28 @@ class FlightRecorder:
         cutoff = time.monotonic() - self.window_s
         return sum(1 for ts in list(self._errors) if ts >= cutoff)
 
+    # -- pressure (docs/hotkeys.md) --------------------------------------
+    def pressure_ratio(self) -> float:
+        """Rolling p99 over the SLO target (1.0 = exactly at target);
+        the multiplier in the hot-key promotion score and the value the
+        owner advertises while pressured.  0 with no samples."""
+        if self.slo_p99_ms <= 0:
+            return 0.0
+        return self.last_p99_ms / self.slo_p99_ms
+
+    def pressure_active(self) -> bool:
+        """True while the CURRENT run of breach evaluations is unbroken
+        (an evaluation back under target clears it — including the
+        window draining empty after traffic stops)."""
+        return self._pressure_since is not None
+
+    def pressure_sustained_s(self) -> float:
+        """Seconds the current breach run has lasted (0 when healthy) —
+        the shedding plane's escalation clock."""
+        if self._pressure_since is None:
+            return 0.0
+        return max(0.0, time.monotonic() - self._pressure_since)
+
     def evaluate(self) -> Optional[str]:
         """One SLO evaluation: refresh the gauges, return a dump reason
         ('slo_breach' / 'error_storm') when a trigger fired outside the
@@ -224,11 +253,21 @@ class FlightRecorder:
             m.slo_p50.set(p50 / 1e3)
             m.slo_p99.set(p99 / 1e3)
         reason: Optional[str] = None
-        if n >= self.min_samples and p99 > self.slo_p99_ms:
+        breaching = n >= self.min_samples and p99 > self.slo_p99_ms
+        if breaching:
             self.breaches += 1
             if m is not None:
                 m.slo_breach_total.inc()
             reason = "slo_breach"
+        # Pressure transitions (docs/hotkeys.md): the sustained-breach
+        # clock the hot-key and shedding planes key off.
+        if breaching and self._pressure_since is None:
+            self._pressure_since = time.monotonic()
+            self.pressure_events += 1
+            self.record("pressure", state="start", p99_ms=round(p99, 3))
+        elif not breaching and self._pressure_since is not None:
+            self._pressure_since = None
+            self.record("pressure", state="clear", p99_ms=round(p99, 3))
         if self.error_storm and self.error_rate() >= self.error_storm:
             reason = reason or "error_storm"
         if reason is None:
@@ -299,6 +338,12 @@ class FlightRecorder:
             },
             "breaches": self.breaches,
             "dumps": self.dumps,
+            "pressure": {
+                "active": self.pressure_active(),
+                "sustained_s": round(self.pressure_sustained_s(), 2),
+                "ratio": round(self.pressure_ratio(), 3),
+                "events": self.pressure_events,
+            },
             "ring": ring,
         }
 

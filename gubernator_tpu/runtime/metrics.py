@@ -155,8 +155,10 @@ class Metrics:
         )
         self.peer_shed_total = Counter(
             "gubernator_peer_shed_total",
-            "Peer-client enqueues shed before any RPC was issued, by "
-            "reason (queue_full | breaker_open).",
+            "Requests shed before any device or peer work, by reason: "
+            "queue_full / breaker_open (peer-client enqueue gates, "
+            "peerAddr = the peer) and pressure (SLO-driven adaptive "
+            "shedding on this node, peerAddr = 'local').",
             ["peerAddr", "reason"],
             registry=r,
         )
@@ -172,6 +174,34 @@ class Metrics:
             "Responses served by the degraded-mode ownership fallback "
             "while the owner peer was unreachable, by mode.",
             ["mode"],  # fail_closed | fail_open | local_shadow
+            registry=r,
+        )
+
+        # -- hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md) --
+        self.hotkey_hot_keys = Gauge(
+            "gubernator_hotkey_hot_keys",
+            "Keys currently in the exact hot-set (promoted by the "
+            "pressure-gated hot-key detector).",
+            registry=r,
+        )
+        self.hotkey_promotions = Counter(
+            "gubernator_hotkey_promotions_total",
+            "Keys promoted into the hot-set (pressure score past "
+            "GUBER_HOTKEY_THRESHOLD for promote_windows consecutive "
+            "windows).",
+            registry=r,
+        )
+        self.hotkey_demotions = Counter(
+            "gubernator_hotkey_demotions_total",
+            "Keys demoted from the hot-set (score below threshold for "
+            "demote_windows consecutive windows).",
+            registry=r,
+        )
+        self.hotkey_mirror_served = Counter(
+            "gubernator_hotkey_mirror_served_total",
+            "Hot-key checks served from this node's local mirror "
+            "allowance (fraction x limit) while the key's owner "
+            "advertised SLO pressure.",
             registry=r,
         )
 

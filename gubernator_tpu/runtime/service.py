@@ -33,6 +33,7 @@ an eventually-consistent view of cross-region hit pressure over DCN.
 from __future__ import annotations
 
 import asyncio
+import fnmatch
 import logging
 import random
 import time
@@ -79,6 +80,8 @@ ASYNC_RETRIES = 5  # forwarded-request ownership-change retries (gubernator.go:3
 # from `<unique_key>` + this suffix, so shadow admission state never
 # collides with the real key's authoritative or cached rows.
 SHADOW_SUFFIX = ".degraded-shadow"
+
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
 
 
 def forward_backoff_s(
@@ -207,6 +210,27 @@ class Service:
             # Every actual spill — policy-driven or operator-called —
             # hits the Prometheus counter.
             self.sketch_backend.on_spill = self.metrics.sketch_spillover.inc
+        # Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md):
+        # detection over the traffic this node routes.  Promotion is
+        # gated on MEASURED owner pressure, so without a flight
+        # recorder (or with every owner healthy) the tracker is inert.
+        self.hotkeys = None
+        if self.cfg.hotkey.enabled:
+            from gubernator_tpu.runtime.hotkey import HotKeyTracker
+
+            self.hotkeys = HotKeyTracker(
+                self.cfg.hotkey, metrics=self.metrics
+            )
+            self.hotkeys.pressure_fn = self._owner_pressure_of
+            self.hotkeys.on_demote = self._on_hot_demote
+        # fp -> RESET_REMAINING req that drops the local mirror slot
+        # when its key demotes (the shadow-drop discipline).
+        self._mirror_resets: Dict[int, RateLimitReq] = {}
+        # (built_monotonic, tracker version, int64 fps) cache for the
+        # fast lane's active-mirror mask.
+        self._mirror_fps_cache = None
+        self.mirror_served = 0
+        self.shed_served = 0
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         # On a mesh backend, GLOBAL keys owned by THIS node serve from the
@@ -320,6 +344,7 @@ class Service:
             metrics=self.metrics,
             circuit=self.cfg.circuit,
             chaos=self.chaos,
+            pressure_ttl_s=self.cfg.hotkey.pressure_ttl_s,
         )
         # Heal detection for the degraded-mode fallback: ANY successful
         # RPC to the peer (object path, compiled raw lane, GLOBAL
@@ -362,6 +387,321 @@ class Service:
         ]
 
     # ------------------------------------------------------------------
+    # hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md)
+    # ------------------------------------------------------------------
+    def note_traffic(
+        self, key_hashes: np.ndarray, hits: np.ndarray
+    ) -> None:
+        """Feed the hot-key detector one batch of routed traffic.
+        Called once per batch by whichever path actually serves it (the
+        compiled lane's check_raw or the object path), so a fast-lane
+        fallback never observes the same requests twice."""
+        hk = self.hotkeys
+        if hk is not None and len(key_hashes):
+            hk.observe(key_hashes, hits)
+
+    def _peer_by_fp(self, fp: int) -> Optional[PeerClient]:
+        """Owning peer for a device fingerprint — xx rings only, where
+        the ring hash IS the XXH64 key fingerprint (the fast router's
+        own premise, replicated_hash.ring_arrays).  None on fnv interop
+        rings or an empty pool."""
+        from gubernator_tpu.net.replicated_hash import xx_64
+
+        pick = self.local_picker
+        if pick.size() == 0 or pick.hash_fn is not xx_64:
+            return None
+        ring, ring_idx, peers = pick.ring_arrays()
+        if not len(ring):
+            return None
+        i = int(np.searchsorted(
+            ring, np.int64(fp).astype(np.uint64), side="left"
+        ))
+        if i == len(ring):
+            i = 0
+        # ring_idx is the picker's host-side numpy cache, never a
+        # device array.
+        idx = int(ring_idx[i])  # gubguard: ok=host-sync
+        return peers[idx]
+
+    def _owner_pressure_of(self, fp: int) -> float:
+        """Owner SLO-pressure ratio for a key fingerprint — the
+        multiplier in the hot-key promotion score.  Keys we own use our
+        own flight recorder's sustained-breach state; keys a peer owns
+        use the ratio that peer advertised on RPC trailing metadata
+        (0 once its TTL lapsed).  On fnv interop rings (no fp->owner
+        mapping) the strongest signal anywhere applies — conservative:
+        it can only promote more, and mirror membership is still
+        checked per key at serve time."""
+        fr = getattr(self.metrics, "flightrec", None)
+        own = (
+            fr.pressure_ratio()
+            if fr is not None and fr.pressure_active() else 0.0
+        )
+        peer = self._peer_by_fp(fp)
+        if peer is not None:
+            if peer.info().is_owner:
+                return own
+            return peer.pressure_ratio()
+        peers = self.local_picker.peers()
+        if not peers:
+            return own
+        return max(
+            [own]
+            + [
+                p.pressure_ratio() for p in peers
+                if not p.info().is_owner
+            ]
+        )
+
+    def _is_mirror_hashed(self, h: int) -> bool:
+        """True when this node is one of the key's next-arc mirror
+        replicas (owner excluded) for ring hash `h`."""
+        try:
+            cand = self.local_picker.get_n_hashed(
+                h, 1 + self.cfg.hotkey.mirrors
+            )
+        except PoolEmptyError:
+            return False
+        return any(p.info().is_owner for p in cand[1:])
+
+    def _mirror_eligible(
+        self, req: RateLimitReq, key: str, peer: PeerClient
+    ) -> bool:
+        """Should this forwarded check serve from a local mirror
+        allowance instead?  All four gates must hold: widening enabled,
+        the owner currently advertising pressure, the key promoted into
+        the hot-set, and this node among the key's next-arc replicas.
+        Sketch-tier names never mirror (the CMS tier is already
+        cardinality-safe and counts once at the owner)."""
+        hk = self.hotkeys
+        hkc = self.cfg.hotkey
+        if hk is None or hkc.mirrors <= 0:
+            return False
+        if not peer.pressure_active():
+            return False
+        if (
+            self.sketch_backend is not None
+            and self.sketch_backend.handles(req)
+        ):
+            return False
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.runtime.hotkey import fp64
+
+        if not hk.is_hot(fp64(key_hash64(key))):
+            return False
+        return self._is_mirror_hashed(
+            self.local_picker.hash_fn(key.encode())
+        )
+
+    def active_mirror_fps(self) -> np.ndarray:
+        """int64 fingerprints this node is actively mirroring right now
+        (hot AND owner pressured AND we are a next-arc replica) — the
+        compiled lane's pull-out mask.  Cached per tracker version with
+        a short TTL so pressure transitions land within ~a window.
+        Empty on fnv interop rings (the object path still mirrors
+        there; only the columnar mask needs the fp->owner mapping)."""
+        hk = self.hotkeys
+        if hk is None or self.cfg.hotkey.mirrors <= 0:
+            return _EMPTY_I64
+        hot = hk.hot_arr
+        if not len(hot):
+            return _EMPTY_I64
+        now = time.monotonic()
+        cached = self._mirror_fps_cache
+        if (
+            cached is not None
+            and cached[1] == hk.version
+            and now - cached[0] < 0.25
+        ):
+            return cached[2]
+        active = [
+            int(fp) for fp in hot if self._fp_actively_mirrored(int(fp))
+        ]
+        arr = (
+            np.array(active, dtype=np.int64) if active else _EMPTY_I64
+        )
+        self._mirror_fps_cache = (now, hk.version, arr)
+        return arr
+
+    def _fp_actively_mirrored(self, fp: int) -> bool:
+        peer = self._peer_by_fp(fp)
+        if peer is None or peer.info().is_owner:
+            return False
+        if not peer.pressure_active():
+            return False
+        return self._is_mirror_hashed(int(np.int64(fp).astype(np.uint64)))
+
+    async def _mirror_serve(
+        self, req: RateLimitReq, peer: PeerClient
+    ) -> RateLimitResp:
+        """Serve a hot key from this mirror's LOCAL allowance while its
+        owner is under measured SLO pressure.
+
+        The admission algebra is local_shadow's with pressure (not
+        death) as the gate: the check rewrites onto
+        `<unique_key>.hot-mirror` — its own slot in the local table —
+        at `fraction x limit`, so each of the `mirrors` next-arc
+        replicas admits at most fraction x limit per window and
+        cluster-wide admission for the key stays within
+        limit x (1 + mirrors x fraction).  The ORIGINAL hits reconcile
+        to the owner through the GLOBAL async-hit machinery
+        (aggregated, provably-unsent-gated — at most once), so the
+        authoritative row converges on the true total."""
+        from dataclasses import replace as dc_replace
+
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.runtime.hotkey import MIRROR_SUFFIX, fp64
+
+        owner = peer.info().grpc_address
+        hkc = self.cfg.hotkey
+        self.mirror_served += 1
+        self.metrics.hotkey_mirror_served.inc()
+        self.metrics.getratelimit_counter.labels("local").inc()
+        if req.limit <= 0:
+            # Deny-all keys stay deny-all on mirrors (the local_shadow
+            # rule): the max(1, ...) floor keeps small positive limits
+            # serviceable, never fails-open an explicit zero.
+            return RateLimitResp(
+                status=Status.OVER_LIMIT,
+                limit=req.limit,
+                remaining=0,
+                reset_time=self._resolve_reset_ms(req),
+                metadata={"hotkey": "mirror", "owner": owner},
+            )
+        mirror_limit = max(1, int(req.limit * hkc.fraction))
+        mirror = dc_replace(
+            req,
+            unique_key=req.unique_key + MIRROR_SUFFIX,
+            limit=mirror_limit,
+            burst=min(req.burst, mirror_limit) if req.burst else 0,
+            behavior=Behavior(
+                int(req.behavior)
+                & ~int(Behavior.GLOBAL)
+                & ~int(Behavior.MULTI_REGION)
+            ),
+        )
+        resps = await self._check_local([mirror])
+        resp = resps[0]
+        if not resp.error:
+            md = dict(resp.metadata) if resp.metadata else {}
+            md["hotkey"] = "mirror"
+            md["owner"] = owner
+            resp.metadata = md
+            fp = fp64(key_hash64(req.hash_key()))
+            if self.hotkeys is not None:
+                self.hotkeys.note_name(fp, req.hash_key())
+            # Reconcile the ORIGINAL hits toward the owner (async,
+            # aggregated per key — global.go:87-95's queue).
+            if req.hits:
+                self.global_mgr.queue_hit(dc_replace(req))
+            # Remember how to drop this mirror slot when the key
+            # demotes: zero-hit RESET_REMAINING removes a token row
+            # outright and re-fills a leaky one (the shadow-drop
+            # mechanics, _drop_shadow).
+            self._mirror_resets[fp] = dc_replace(
+                mirror,
+                hits=0,
+                behavior=Behavior(
+                    int(mirror.behavior) | int(Behavior.RESET_REMAINING)
+                ),
+            )
+        return resp
+
+    def _on_hot_demote(self, fps: List[int]) -> None:
+        """Tracker callback (outside its lock, any thread): the keys
+        collapsed out of the hot-set — drop their local mirror slots so
+        no stale mirror admission state survives the widening."""
+        resets = [
+            self._mirror_resets.pop(fp)
+            for fp in fps
+            if fp in self._mirror_resets
+        ]
+        if not resets:
+            return
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def submit() -> None:
+            t = asyncio.ensure_future(self._reset_mirrors(resets))
+            self._shadow_tasks.add(t)
+            t.add_done_callback(self._shadow_tasks.discard)
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            submit()
+        else:
+            loop.call_soon_threadsafe(submit)
+
+    async def _reset_mirrors(self, resets: List[RateLimitReq]) -> None:
+        try:
+            await self._check_local(resets)
+            fr = getattr(self.metrics, "flightrec", None)
+            if fr is not None:
+                fr.record("hotkey_mirror_drop", keys=len(resets))
+        except Exception as e:  # noqa: BLE001 — slots expire anyway
+            log.warning("mirror reset after demotion failed: %s", e)
+
+    # ------------------------------------------------------------------
+    # SLO-driven adaptive shedding (docs/hotkeys.md)
+    # ------------------------------------------------------------------
+    def shed_level(self) -> int:
+        """Current shed escalation level.  0 = no shedding.  Level L
+        sheds requests whose priority class index is < L, where classes
+        are the `shed_priorities` globs in lowest-priority-first order.
+        Arms only once this node's own p99 breach run has persisted
+        `shed_cooldown_s` (the flight recorder's sustained-breach
+        clock), escalating one class per further cooldown — and never
+        sheds names matching no glob."""
+        hkc = self.cfg.hotkey
+        if not hkc.enabled or not hkc.shed_priorities:
+            return 0
+        fr = getattr(self.metrics, "flightrec", None)
+        if fr is None:
+            return 0
+        sustained = fr.pressure_sustained_s()
+        if sustained < hkc.shed_cooldown_s:
+            return 0
+        return min(
+            1 + int((sustained - hkc.shed_cooldown_s)
+                    // hkc.shed_cooldown_s),
+            len(hkc.shed_priorities),
+        )
+
+    def shed_priority(self, name: str) -> int:
+        """Priority class of a limit name: the index of the first
+        matching glob (0 sheds first); names matching none rank past
+        every class and are never shed."""
+        for i, pat in enumerate(self.cfg.hotkey.shed_priorities):
+            if fnmatch.fnmatch(name, pat):
+                return i
+        return len(self.cfg.hotkey.shed_priorities)
+
+    def _shed_response(self, req: RateLimitReq) -> RateLimitResp:
+        """DROP with retry-after rather than queueing: an overloaded
+        node must not stack deferred work it cannot serve
+        (arXiv:2510.04516's requester-side admission argument)."""
+        self.shed_served += 1
+        self.metrics.peer_shed_total.labels(
+            peerAddr="local", reason="pressure"
+        ).inc()
+        retry_ms = int(self.cfg.hotkey.shed_cooldown_s * 1000)
+        now_ms = int(self.clock.now_ns() // 1_000_000)
+        return RateLimitResp(
+            status=Status.OVER_LIMIT,
+            limit=req.limit,
+            remaining=0,
+            reset_time=now_ms + retry_ms,
+            metadata={
+                "shed": "pressure",
+                "retry_after_ms": str(retry_ms),
+            },
+        )
+
+    # ------------------------------------------------------------------
     # client API
     # ------------------------------------------------------------------
     async def get_rate_limits(
@@ -397,8 +737,20 @@ class Service:
         local_cached: List[bool] = []
         local_owner_meta: List[Optional[str]] = []
         forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
+        mirrors: List[Tuple[int, PeerClient, RateLimitReq]] = []
 
         reqs = self._strip_sketch_global(reqs)
+
+        if self.hotkeys is not None:
+            valid = [r for r in reqs if r.unique_key and r.name]
+            if valid:
+                from gubernator_tpu.core.hashing import bulk_key_hash64
+
+                self.note_traffic(
+                    bulk_key_hash64([r.hash_key() for r in valid]),
+                    np.array([r.hits for r in valid], dtype=np.int64),
+                )
+        shed = self.shed_level()
 
         engine_idx: List[int] = []
 
@@ -420,6 +772,13 @@ class Service:
                 responses[i] = RateLimitResp(
                     error="field 'namespace' cannot be empty"
                 )
+                continue
+            if shed and self.shed_priority(req.name) < shed:
+                # SLO-driven shedding (docs/hotkeys.md): the breach run
+                # outlasted the cooldown — drop low-priority traffic
+                # BEFORE any routing, device work, or replication
+                # queueing (a shed request must leave no state behind).
+                responses[i] = self._shed_response(req)
                 continue
             key = req.hash_key()
             is_global = has_behavior(req.behavior, Behavior.GLOBAL)
@@ -465,12 +824,22 @@ class Service:
                 local_cached.append(True)
                 local_owner_meta.append(peer.info().grpc_address)
                 self.global_mgr.queue_hit(req)
+            elif self._mirror_eligible(req, key, peer):
+                # Hot-key widening (docs/hotkeys.md): the owner is
+                # measurably pressured and this node is one of the
+                # key's next-arc mirrors — serve from the local
+                # allowance instead of piling onto the owner.
+                mirrors.append((i, peer, req))
             else:
                 forwards.append((i, peer, req, key))
 
         tasks = [
             asyncio.ensure_future(self._forward(peer, req, key))
             for (_, peer, req, key) in forwards
+        ]
+        mirror_tasks = [
+            asyncio.ensure_future(self._mirror_serve(req, peer))
+            for (_, peer, req) in mirrors
         ]
 
         try:
@@ -504,6 +873,18 @@ class Service:
                         responses[i] = RateLimitResp(
                             error=f"Error while fetching rate limit "
                             f"'{key}' from peer: {resp}"
+                        )
+                    else:
+                        responses[i] = resp
+            if mirror_tasks:
+                results = await asyncio.gather(
+                    *mirror_tasks, return_exceptions=True
+                )
+                for (i, _, req), resp in zip(mirrors, results):
+                    if isinstance(resp, BaseException):
+                        responses[i] = RateLimitResp(
+                            error=f"Error serving hot-key mirror for "
+                            f"'{req.hash_key()}': {resp}"
                         )
                     else:
                         responses[i] = resp
@@ -667,6 +1048,23 @@ class Service:
                     f"from peer: {e}"
                 )
 
+    def _resolve_reset_ms(self, req: RateLimitReq) -> int:
+        """reset_time for a synthesized (degraded / mirror-denied)
+        answer.  req.duration under DURATION_IS_GREGORIAN is a
+        calendar-interval id (0-5), NOT milliseconds — resolve it
+        through the same expansion the algorithm layer uses, or omit
+        reset_time when the id is invalid (the authoritative path would
+        error on it anyway)."""
+        now_ms = int(self.clock.now_ns() // 1_000_000)
+        if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
+            try:
+                return gregorian_expiration(
+                    self.clock.now(), int(req.duration)
+                )
+            except GregorianError:
+                return 0
+        return now_ms + max(int(req.duration), 0)
+
     # ------------------------------------------------------------------
     # degraded-mode ownership fallback (docs/resilience.md)
     # ------------------------------------------------------------------
@@ -708,20 +1106,7 @@ class Service:
         fr = getattr(self.metrics, "flightrec", None)
         if fr is not None:
             fr.record("degraded", mode=mode, key=key, owner=owner)
-        now_ms = int(self.clock.now_ns() // 1_000_000)
-        if has_behavior(req.behavior, Behavior.DURATION_IS_GREGORIAN):
-            # req.duration is a calendar-interval id (0-5), NOT
-            # milliseconds — resolve it through the same expansion the
-            # algorithm layer uses, or omit reset_time when the id is
-            # invalid (the authoritative path would error on it anyway).
-            try:
-                reset_ms = gregorian_expiration(
-                    self.clock.now(), int(req.duration)
-                )
-            except GregorianError:
-                reset_ms = 0
-        else:
-            reset_ms = now_ms + max(int(req.duration), 0)
+        reset_ms = self._resolve_reset_ms(req)
         if mode == "fail_closed":
             return RateLimitResp(
                 status=Status.OVER_LIMIT,
@@ -832,6 +1217,35 @@ class Service:
         # client's original bytes — re-strip here so a GLOBAL+sketch
         # request never queues an exact-table broadcast for a sketch key.
         reqs = self._strip_sketch_global(reqs)
+        if self.hotkeys is not None:
+            # Owner-side detection: forwarded traffic is exactly the
+            # load a pressured owner needs to see per key.
+            valid = [r for r in reqs if r.unique_key and r.name]
+            if valid:
+                from gubernator_tpu.core.hashing import bulk_key_hash64
+
+                self.note_traffic(
+                    bulk_key_hash64([r.hash_key() for r in valid]),
+                    np.array([r.hits for r in valid], dtype=np.int64),
+                )
+        shed = self.shed_level()
+        if shed:
+            # Owner-side shedding of forwarded traffic — the relief
+            # valve that actually unloads a pressured owner.
+            shed_idx = {
+                i for i, r in enumerate(reqs)
+                if r.name and self.shed_priority(r.name) < shed
+            }
+            if shed_idx:
+                kept = [
+                    r for i, r in enumerate(reqs) if i not in shed_idx
+                ]
+                inner = await self._check_local(kept) if kept else []
+                it = iter(inner)
+                return [
+                    self._shed_response(r) if i in shed_idx else next(it)
+                    for i, r in enumerate(reqs)
+                ]
         return await self._check_local(reqs)
 
     async def update_peer_globals(
@@ -894,6 +1308,29 @@ class Service:
         if errs:
             h.status = UNHEALTHY
             h.message = "|".join(errs)
+        # Pressure plane (docs/hotkeys.md): an overloaded-but-ALIVE
+        # peer — clean error window, breaker closed, SLO advertised
+        # breached — must not read as fully healthy.  Advisory lines
+        # only: the peer IS serving, so status stays driven by
+        # connectivity (flipping it would invite LB churn on exactly
+        # the node that needs its traffic spread, not removed).
+        pressure_lines = []
+        for peer in local_peers + region_peers:
+            ratio = peer.pressure_ratio()
+            if ratio >= 1.0:
+                pressure_lines.append(
+                    f"Pressure on peer {peer.info().grpc_address}: "
+                    f"advertised p99 at {ratio:.2f}x its SLO target"
+                )
+        lvl = self.shed_level()
+        if lvl:
+            pressure_lines.append(
+                f"Pressure shedding active on this node (level {lvl} "
+                f"of {len(self.cfg.hotkey.shed_priorities)})"
+            )
+        if pressure_lines:
+            extra = "|".join(pressure_lines)
+            h.message = f"{h.message}|{extra}" if h.message else extra
         # SLO telemetry rides along (runtime/flightrec.py): the rolling
         # p99 vs the configured target, so degraded-mode decisions can
         # key off measured tail latency (status itself stays driven by

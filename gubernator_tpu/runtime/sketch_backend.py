@@ -33,6 +33,74 @@ from gubernator_tpu.core.config import SketchTierConfig
 from gubernator_tpu.core.types import RateLimitReq, RateLimitResp, Status
 
 
+class HostCMS:
+    """The CMS tier's estimator (ops/sketch.py) re-expressed in numpy
+    for HOST-side frequency tracking — the hot-key detector's sketch
+    (runtime/hotkey.py).
+
+    Same contract as the device tier: per-row multiply-shift universal
+    hashing over the int64 key fingerprints, min over `depth` rows,
+    never underestimates.  Window semantics are the caller's: the
+    tracker tumbles windows with the same boundary arithmetic the
+    device kernel's rotation uses (`SketchBackend._advance_window`) and calls
+    `clear()` at each boundary.  Memory is O(depth x width) regardless
+    of key cardinality, so a zipfian storm cannot grow host state."""
+
+    # Fixed odd multipliers (splitmix64-style constants) — one per row,
+    # so the rows are independent hash functions of the SAME
+    # fingerprint the device table and the ring router already use.
+    _MULTS = (
+        0x9E3779B97F4A7C15,
+        0xBF58476D1CE4E5B9,
+        0x94D049BB133111EB,
+        0xD6E8FEB86659FD93,
+        0xA0761D6478BD642F,
+        0xE7037ED1A0B428DB,
+    )
+
+    def __init__(self, depth: int = 4, width: int = 4096) -> None:
+        if width & (width - 1) or width <= 0:
+            raise ValueError(f"HostCMS width must be a power of two, "
+                             f"got {width}")
+        if not 1 <= depth <= len(self._MULTS):
+            raise ValueError(
+                f"HostCMS depth must be 1..{len(self._MULTS)}, "
+                f"got {depth}"
+            )
+        self.depth = depth
+        self.width = width
+        self._shift = np.uint64(64 - int(width).bit_length() + 1)
+        self._mults = [np.uint64(m) for m in self._MULTS[:depth]]
+        self.table = np.zeros((depth, width), dtype=np.int64)
+
+    def _row_idx(self, u: np.ndarray, d: int) -> np.ndarray:
+        # Multiply-shift: top log2(width) bits of (u * odd_const).
+        with np.errstate(over="ignore"):
+            return ((u * self._mults[d]) >> self._shift).astype(np.int64)
+
+    def update(self, key_hashes: np.ndarray, weights: np.ndarray) -> None:
+        """Add `weights[i]` to fingerprint `key_hashes[i]` (vectorized;
+        duplicate fingerprints in one call accumulate)."""
+        u = key_hashes.view(np.uint64)
+        w = weights.astype(np.int64, copy=False)
+        for d in range(self.depth):
+            np.add.at(self.table[d], self._row_idx(u, d), w)
+
+    def estimate(self, key_hashes: np.ndarray) -> np.ndarray:
+        """Min-over-rows point estimates; >= the true count, always."""
+        u = key_hashes.view(np.uint64)
+        est = self.table[0][self._row_idx(u, 0)]
+        for d in range(1, self.depth):
+            est = np.minimum(est, self.table[d][self._row_idx(u, d)])
+        return est
+
+    def estimate_one(self, key_hash: int) -> int:
+        return int(self.estimate(np.array([key_hash], dtype=np.int64))[0])
+
+    def clear(self) -> None:
+        self.table[:] = 0
+
+
 def make_multi_step(impl):
     """Jitted scan over k chunks: ONE dispatch per merge, chunks applied
     in order on device (each sees the previous chunk's adds, the same

@@ -4,5 +4,6 @@ from gubernator_tpu.testing.chaos import (  # noqa: F401
     ChaosInjector,
     ChaosPlan,
     Rule,
+    zipf_keys,
 )
 from gubernator_tpu.testing.cluster import Cluster  # noqa: F401

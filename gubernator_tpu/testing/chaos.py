@@ -56,6 +56,20 @@ import grpc
 import grpc.aio
 
 
+def zipf_keys(seed: int, s: float, n: int, universe: int):
+    """Seeded zipfian key indices for storm scenarios: `n` draws over
+    `[0, universe)` with exponent `s` (rank-frequency skew; s ~ 1.1-1.5
+    models production key popularity).  Deterministic from the seed —
+    the same discipline as the fault plans, so a hot-key overload
+    scenario reproduces from (seed, s) alone.  Used by
+    scripts/chaos_smoke.py and the bench_e2e --workload zipf:<s>
+    config."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return (rng.zipf(s, size=n) - 1) % universe
+
+
 def injected_rpc_error(
     status: str, message: str, debug: Optional[str] = None
 ) -> grpc.aio.AioRpcError:

@@ -1,15 +1,25 @@
-"""CI smoke: a short seeded fault plan against a 3-daemon in-process
-cluster (the compressed version of tests/test_chaos.py).
+"""CI smoke: seeded chaos scenarios against a 3-daemon in-process
+cluster (the compressed version of tests/test_chaos.py +
+tests/test_hotkey.py).
 
-Boots three real daemons on one loop with per-peer circuit breakers,
-`local_shadow` degraded mode and the flight recorder armed, injects a
-seeded storm of client/server faults (>=30% of peer RPCs fail), then
-asserts the resilience invariants end to end:
+Scenarios (--scenario storm|hotkey|all; default storm — the original
+job; CI runs hotkey as its own required step):
 
-  * zero double counts — every key's applied hits on its owner equal
-    exactly the successful responses the client saw;
-  * at least one breaker tripped during the storm;
-  * after heal, every opened breaker re-closes and forwards succeed.
+  storm   a seeded storm of client/server faults (>=30% of peer RPCs
+          fail) with breakers + `local_shadow` degraded mode armed:
+          zero double counts, at least one breaker trips, every breaker
+          re-closes after heal.
+
+  hotkey  a seeded ZIPFIAN storm that overloads ONE owner
+          (docs/hotkeys.md): server-side delay injection drives the
+          owner's measured p99 through its SLO; the smoke then asserts
+          the hot-key survival plane end to end — mirroring provably
+          inactive before pressure, total admitted hits for the hot
+          key within limit x (1 + mirrors x fraction) during the
+          storm, shedding priority-ordered on the pressured owner (a
+          sheddable class drops with retry-after while an unmatched
+          class serves), and after the skew clears the hot-set demotes
+          to empty with the widening fully collapsed.
 
 On any failure each daemon's flight recorder dumps its ring to
 GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
@@ -36,11 +46,14 @@ KEYS = 20
 ROUNDS = 5
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--seed", type=int, default=1337)
-    args = ap.parse_args()
+def _dump_flightrec(cluster, reason: str) -> None:
+    for d in cluster.daemons:
+        if d.flightrec is not None:
+            path = cluster.run(d.flightrec.dump(reason))
+            print(f"flightrec dump ({d.grpc_address}): {path}")
 
+
+def storm_scenario(seed: int) -> None:
     from gubernator_tpu.client import V1Client
     from gubernator_tpu.core.config import CircuitConfig, DaemonConfig
     from gubernator_tpu.core.types import RateLimitReq
@@ -50,6 +63,7 @@ def main() -> None:
         Cluster,
         Rule,
     )
+    args = argparse.Namespace(seed=seed)
 
     injector = ChaosInjector(ChaosPlan(seed=args.seed))
     injector.set_active(False)  # boot/peer-discovery runs clean
@@ -71,12 +85,6 @@ def main() -> None:
             ),
         ),
     )
-
-    def dump_flightrec(reason: str) -> None:
-        for d in cluster.daemons:
-            if d.flightrec is not None:
-                path = cluster.run(d.flightrec.dump(reason))
-                print(f"flightrec dump ({d.grpc_address}): {path}")
 
     try:
         # The same fault mix as test_seeded_plan_no_double_count, with
@@ -182,10 +190,225 @@ def main() -> None:
             f"all breakers re-closed"
         )
     except BaseException:
-        dump_flightrec("chaos-smoke-failure")
+        _dump_flightrec(cluster, "chaos-smoke-failure")
         raise
     finally:
         cluster.stop()
+
+
+def hotkey_scenario(seed: int) -> None:
+    """The zipfian single-owner overload (docs/hotkeys.md lifecycle)."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import DaemonConfig, HotKeyConfig
+    from gubernator_tpu.core.types import RateLimitReq, Status
+    from gubernator_tpu.testing import (
+        ChaosInjector,
+        ChaosPlan,
+        Cluster,
+        Rule,
+        zipf_keys,
+    )
+
+    hot_limit = 200
+    mirrors, fraction = 1, 0.25
+    injector = ChaosInjector(ChaosPlan(seed=seed))
+    injector.set_active(False)  # boot runs clean
+    cluster = Cluster.start_with(
+        ["", "", ""],
+        conf_template=DaemonConfig(
+            hotkey=HotKeyConfig(
+                threshold=50.0, mirrors=mirrors, fraction=fraction,
+                window_s=0.3, promote_windows=2, demote_windows=2,
+                pressure_ttl_s=1.5, shed_cooldown_s=0.4,
+                shed_priorities=["bulk.*"],
+            ),
+            chaos=injector,
+            flightrec=True,
+            flightrec_dir=os.environ.get(
+                "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+            ),
+        ),
+    )
+    try:
+        for d in cluster.daemons:
+            # Shorten the rolling window so pressure clears within the
+            # smoke budget after the skew stops; keep the production
+            # 2ms target — the injected delay breaches it organically.
+            d.flightrec.window_s = 2.0
+            d.flightrec.slo_p99_ms = 2.0
+
+        d0 = cluster.daemons[0]
+        # A hot key owned by ANOTHER daemon whose first next-arc mirror
+        # is d0 — deterministic from the shared ring.
+        hot_key = next(
+            f"h{i}" for i in range(2000)
+            if not d0.service.local_picker.get_n(
+                f"hot_h{i}", 2)[0].info().is_owner
+            and d0.service.local_picker.get_n(
+                f"hot_h{i}", 2)[1].info().is_owner
+        )
+        hash_key = f"hot_{hot_key}"
+        owner = cluster.owner_daemon_of(hash_key)
+        owner_addr = owner.grpc_address
+        # Zipfian tail around the hot head: seeded background draws.
+        tail = zipf_keys(seed, 1.3, 4000, 500)
+
+        cl = V1Client(d0.grpc_address)
+        admitted = 0
+        mirror_meta = 0
+
+        def storm_round(n_hot: int, round_idx: int):
+            nonlocal admitted, mirror_meta
+            reqs = [
+                RateLimitReq(name="hot", unique_key=hot_key, hits=1,
+                             limit=hot_limit, duration=DURATION)
+                for _ in range(n_hot)
+            ] + [
+                RateLimitReq(name="hot", unique_key=f"t{t}", hits=1,
+                             limit=LIMIT, duration=DURATION)
+                for t in tail[round_idx * 20:(round_idx + 1) * 20]
+            ]
+            for r, req in zip(cl.get_rate_limits(reqs, timeout=30),
+                              reqs):
+                if req.unique_key != hot_key:
+                    continue
+                if r.error == "" and r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+                if (r.metadata or {}).get("hotkey") == "mirror":
+                    mirror_meta += 1
+
+        try:
+            # Phase 0 — skewed traffic, NO owner pressure: mirroring
+            # must be provably inactive.
+            for i in range(4):
+                storm_round(30, i)
+                time.sleep(0.1)
+            assert d0.service.mirror_served == 0, (
+                "mirroring active without measured owner pressure"
+            )
+            assert len(d0.service.active_mirror_fps()) == 0
+
+            # Phase 1 — overload the owner: every peer RPC it serves
+            # gains an injected 25ms server-side delay, so its MEASURED
+            # p99 breaches the 2ms SLO while it stays fully alive.
+            injector.reset(ChaosPlan(seed=seed, rules=[
+                Rule(op="delay", where="server", phase="before",
+                     target=owner_addr, method="GetPeerRateLimits",
+                     probability=1.0, delay_s=0.025),
+            ]))
+            deadline = time.monotonic() + 30.0
+            i = 4
+            while time.monotonic() < deadline:
+                storm_round(50, i % 100)
+                i += 1
+                if mirror_meta > 0:
+                    break
+            assert mirror_meta > 0, "mirroring never activated"
+            owner_peer = d0.service.get_peer(hash_key)
+            assert owner_peer.pressure_ratio() >= 1.0, (
+                "owner pressure never advertised"
+            )
+            assert owner_peer.circuit_state_name() in (
+                "closed", "disabled"
+            ), "breaker tripped — the owner must be alive, only slow"
+
+            # Saturate both allowances, then check the proven bound.
+            for _ in range(8):
+                storm_round(60, i % 100)
+                i += 1
+            bound = hot_limit * (1 + mirrors * fraction)
+            assert admitted <= bound, (
+                f"over-admission: {admitted} > {bound}"
+            )
+            assert admitted >= hot_limit * 0.75, (
+                f"storm never saturated the key ({admitted})"
+            )
+
+            # Priority-ordered shedding on the pressured owner: the
+            # sheddable class drops with retry-after, the unmatched
+            # class serves.
+            cl_o = V1Client(owner_addr)
+            try:
+                def shed_seen():
+                    rs = cl_o.get_rate_limits([
+                        RateLimitReq(name="bulk.jobs", unique_key="b",
+                                     hits=1, limit=LIMIT,
+                                     duration=DURATION),
+                        RateLimitReq(name="keep", unique_key="kp",
+                                     hits=1, limit=LIMIT,
+                                     duration=DURATION),
+                    ], timeout=30)
+                    assert (rs[0].metadata or {}).get("shed") == \
+                        "pressure", rs[0]
+                    assert int(rs[0].metadata["retry_after_ms"]) > 0
+                    assert (rs[1].metadata or {}).get("shed") is None, (
+                        "unmatched-priority name was shed"
+                    )
+                    return rs
+
+                shed_deadline = time.monotonic() + 15.0
+                while True:
+                    try:
+                        shed_seen()
+                        break
+                    except AssertionError:
+                        if time.monotonic() > shed_deadline:
+                            raise
+                        storm_round(20, i % 100)
+                        i += 1
+                        time.sleep(0.1)
+            finally:
+                cl_o.close()
+            shed_total = owner.service.shed_served
+
+            # Phase 2 — the skew clears: pressure drains out of the
+            # rolling window, the hot-set demotes to empty, and the
+            # widening fully collapses.
+            injector.heal()
+            collapse_deadline = time.monotonic() + 30.0
+            while time.monotonic() < collapse_deadline:
+                cl.get_rate_limits([
+                    RateLimitReq(name="probe", unique_key="p", hits=1,
+                                 limit=LIMIT, duration=DURATION)
+                ], timeout=30)  # keep detection windows rolling
+                if (not d0.service.hotkeys.hot_set
+                        and len(d0.service.active_mirror_fps()) == 0):
+                    break
+                time.sleep(0.2)
+            assert not d0.service.hotkeys.hot_set, (
+                "hot-set never demoted after the skew cleared"
+            )
+            assert len(d0.service.active_mirror_fps()) == 0
+            print(
+                f"hotkey smoke OK: seed={seed} key={hash_key} "
+                f"owner={owner_addr} admitted={admitted} "
+                f"(bound {bound:g}), mirror_served="
+                f"{d0.service.mirror_served}, owner_shed={shed_total}, "
+                f"promotions={d0.service.hotkeys.promotions}, "
+                f"demotions={d0.service.hotkeys.demotions}, "
+                f"widening collapsed"
+            )
+        finally:
+            cl.close()
+    except BaseException:
+        _dump_flightrec(cluster, "hotkey-smoke-failure")
+        raise
+    finally:
+        cluster.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument(
+        "--scenario", choices=("storm", "hotkey", "all"),
+        default="storm",
+    )
+    args = ap.parse_args()
+    if args.scenario in ("storm", "all"):
+        storm_scenario(args.seed)
+    if args.scenario in ("hotkey", "all"):
+        hotkey_scenario(args.seed)
 
 
 if __name__ == "__main__":

@@ -1,11 +1,12 @@
 """Run bench_e2e on the rig and assemble BENCH_E2E_r{N}.json.
 
 Usage: python scripts/record_bench_e2e.py [seconds] [concurrency] [round]
-                                          [suffix]
+                                          [suffix] [workload]
 
 A non-empty `suffix` names a variant artifact (BENCH_E2E_r{N}_{suffix}
 .json) for A/B runs; the GUBER_FASTPATH_SPARSE env var passes through to
-bench_e2e's cluster configs.
+bench_e2e's cluster configs.  `workload` (e.g. zipf:1.2) adds the
+skewed-key owner-share config (bench_e2e --workload; docs/hotkeys.md).
 """
 import json
 import os
@@ -14,13 +15,17 @@ import sys
 
 SECONDS = sys.argv[1] if len(sys.argv) > 1 else "5"
 CONC = sys.argv[2] if len(sys.argv) > 2 else "16"
-ROUND = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+ROUND = int(sys.argv[3]) if len(sys.argv) > 3 else 7
 SUFFIX = sys.argv[4] if len(sys.argv) > 4 else ""
+WORKLOAD = sys.argv[5] if len(sys.argv) > 5 else "zipf:1.2"
 
 try:
+    cmd = [sys.executable, "/root/repo/bench_e2e.py", "--seconds",
+           SECONDS, "--concurrency", CONC]
+    if WORKLOAD:
+        cmd += ["--workload", WORKLOAD]
     out = subprocess.run(
-        [sys.executable, "/root/repo/bench_e2e.py", "--seconds", SECONDS,
-         "--concurrency", CONC],
+        cmd,
         capture_output=True, text=True, timeout=1800,
     )
     stdout = out.stdout
@@ -57,7 +62,10 @@ _summary_platform = next(
 )
 artifact = {
     "round": ROUND,
-    "harness": f"bench_e2e.py --seconds {SECONDS} --concurrency {CONC}",
+    "harness": (
+        f"bench_e2e.py --seconds {SECONDS} --concurrency {CONC}"
+        + (f" --workload {WORKLOAD}" if WORKLOAD else "")
+    ),
     "platform": (
         "tpu (single chip via axon tunnel)"
         if _summary_platform == "tpu" else (_summary_platform or "unknown")
@@ -99,7 +107,14 @@ artifact = {
         "blocking_fetches_per_check — the ring acceptance criterion is "
         "that ring mode's steady-state blocking device->host fetches on "
         "the request path are ZERO (readbacks move to the ring runner) "
-        "with small-batch p50 at or below the pipelined baseline."
+        "with small-batch p50 at or below the pipelined baseline.  "
+        "Round-7 addition: the zipf_owner_skew_s<sigma> config "
+        "(--workload zipf:<s>) drives seeded zipfian key draws at a "
+        "3-daemon cluster and reports the per-owner share of applied "
+        "checks next to p50/p99 — the single-owner funnel the hot-key "
+        "survival plane (docs/hotkeys.md) exists to survive; its "
+        "mirroring stays provably inactive here because no owner "
+        "breaches its SLO."
     ),
     "results": results,
 }

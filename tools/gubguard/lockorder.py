@@ -43,6 +43,7 @@ CLASS_LOCK_MAP = {
     ("SketchBackend", "_lock"): "sketch._lock",
     ("Store", "_lock"): "store._lock",
     ("MockStore", "_lock"): "store._lock",
+    ("HotKeyTracker", "_lock"): "hotkey._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
@@ -57,6 +58,8 @@ VAR_ALIAS = {
     "sketch": "sketch",
     "sb": "sketch",
     "store": "store",
+    "hotkeys": "hotkey",
+    "hk": "hotkey",
     "flightrec": "flightrec",
     "fr": "flightrec",
 }
@@ -82,6 +85,12 @@ RANK = {
     "engine._lock": 30,
     "sketch._lock": 40,
     "store._lock": 50,
+    # hotkey._lock (runtime/hotkey.py window/hot-set state) is acquired
+    # from routing paths holding nothing and takes nothing while held
+    # (pressure_fn reads lock-free peer/flightrec attrs; flight-recorder
+    # records fire after release) — ranked just before the
+    # record-anything tail locks.
+    "hotkey._lock": 55,
     "flightrec._lock": 60,
     # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
     # flightrec: span bookkeeping may run under ANY layer's lock (a span
