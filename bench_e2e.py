@@ -106,6 +106,7 @@ def bench(seconds: float, concurrency: int,
           depth_sweep: Tuple[int, ...] = (1, 2, 4),
           serve_sweep: Tuple[str, ...] = ("classic", "pipelined", "ring"),
           workload: str = "",
+          mesh_shards: int = 0,
           ) -> None:
     """Sync driver: client coroutines run on each cluster's OWN loop —
     grpc.aio multiplexes one poller per process, and a second event loop
@@ -551,6 +552,80 @@ def bench(seconds: float, concurrency: int,
                 "error": str(e),
             }))
 
+    # ---- mesh serve-mode sweep: the deployment-mode benchmark ----------
+    # Re-run the throughput + small-batch configs per drain discipline
+    # on a MESH daemon (--mesh-shards; the production shape: one daemon
+    # owning a device mesh with the table sharded over it).  Each line
+    # reports per-shard occupancy and — in ring mode — the ring budget
+    # split (slot-wait, per-shard seq), turning MULTICHIP from a dryrun
+    # artifact into a deployment-mode benchmark.
+    for mode in (serve_sweep if mesh_shards > 1 else ()):
+        try:
+            mesh_cfg = DeviceConfig(
+                num_slots=mesh_shards * 8 * 2048,
+                ways=8,
+                batch_size=1024,
+                num_shards=mesh_shards,
+            )
+            c = Cluster.start_with(
+                [""], device=mesh_cfg,
+                conf_template=conf(serve_mode=mode),
+            )
+            try:
+                addr = [c.daemons[0].grpc_address]
+                sweep_seconds = max(2.0, seconds / 2)
+                pays = [build_payload(
+                    [("bench_token", f"k{i}") for i in range(1000)]
+                )]
+                small = [build_payload(
+                    [("bench_lat", f"l{j}") for j in range(10)]
+                )]
+                for name, pl, batch, cc in (
+                    ("token_1k_batch1000", pays, 1000, concurrency),
+                    ("latency_small_batch", small, 10, 4),
+                ):
+                    c.run(drive(addr, pl, 0.5, cc), timeout=120)  # warm
+                    t0 = time.perf_counter()
+                    rpcs, lat = c.run(
+                        drive(addr, pl, sweep_seconds, cc), timeout=120
+                    )
+                    emit(f"mesh_serve_sweep_{name}", rpcs * batch, rpcs,
+                         lat, time.perf_counter() - t0,
+                         {"serve_mode": mode, "concurrency": cc,
+                          "mesh_shards": mesh_shards})
+                fp = c.daemons[0].fastpath
+                be = c.daemons[0].service.backend
+                bf = sum(fp.blocking_fetches.values())
+                line = {
+                    "config": "mesh_serve_sweep_stages",
+                    "serve_mode": mode,
+                    "effective_serve_mode": fp.effective_serve_mode,
+                    "mesh_shards": mesh_shards,
+                    "served": fp.served,
+                    "blocking_fetches": dict(fp.blocking_fetches),
+                    "blocking_fetches_per_check": round(
+                        bf / max(fp.served, 1), 6
+                    ),
+                    "shard_occupancy": be.shard_occupancy(),
+                }
+                if fp._ring is not None:
+                    rdv = fp._ring.debug_vars()
+                    line["ring"] = rdv
+                    if fp.served:
+                        line["ring_slot_wait_us_per_1000"] = round(
+                            rdv["slot_wait_ms_total"] * 1e3
+                            / (fp.served / 1000.0)
+                        )
+                results.append(line)
+                print(json.dumps(line), flush=True)
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate sweep failures
+            print(json.dumps({
+                "config": "mesh_serve_sweep", "serve_mode": mode,
+                "mesh_shards": mesh_shards, "error": str(e),
+            }))
+
     # ---- pipeline-depth sweep: the tentpole A/B ------------------------
     # Re-run the two throughput configs (token_1k dense batches,
     # leaky_1m Zipfian) and the small-batch latency config at each
@@ -851,6 +926,7 @@ def bench(seconds: float, concurrency: int,
         "serve_mode": serve_mode,
         "ring_slots": ring_slots,
         "serve_mode_sweep": list(serve_sweep),
+        "mesh_shards": mesh_shards,
         "device": {
             "num_slots": dev_cfg.num_slots,
             "batch_size": dev_cfg.batch_size,
@@ -884,7 +960,23 @@ def main() -> None:
         "per-owner share of applied checks alongside p50/p99 "
         "(docs/hotkeys.md; empty disables)",
     )
+    ap.add_argument(
+        "--mesh-shards", type=int, default=0,
+        help="re-run the serve-mode sweep on an N-shard mesh daemon "
+        "(the deployment-mode benchmark: per-shard occupancy + ring "
+        "budget split; 0 disables).  On CPU, N virtual devices are "
+        "forced before jax initializes.",
+    )
     args = ap.parse_args()
+    if args.mesh_shards > 1:
+        # Must land before the first jax import (bench() imports jax):
+        # the CPU rig needs N virtual devices for an N-shard mesh.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.mesh_shards}"
+            ).strip()
     sweep = tuple(
         int(d) for d in args.pipeline_depth.split(",") if d.strip()
     )
@@ -892,7 +984,8 @@ def main() -> None:
         m.strip() for m in args.serve_mode.split(",") if m.strip()
     )
     bench(args.seconds, args.concurrency, depth_sweep=sweep,
-          serve_sweep=modes, workload=args.workload)
+          serve_sweep=modes, workload=args.workload,
+          mesh_shards=args.mesh_shards)
 
 
 if __name__ == "__main__":

@@ -236,8 +236,11 @@ def hotkey_config_from_env() -> HotKeyConfig:
 #                merges enter a request ring, ONE runner thread drives
 #                bounded jitted multi-round scans and publishes
 #                responses, and the request path never blocks on a
-#                device->host fetch.  Falls back to pipelined on
-#                backends without single-table ring support (mesh).
+#                device->host fetch.  Served natively by BOTH the
+#                single-table backend and the mesh (the shard_map ring
+#                step, parallel/sharded.make_mesh_ring_step) — ring on
+#                a mesh no longer silently falls back; only a backend
+#                without ring support degrades to pipelined.
 SERVE_MODES = ("classic", "pipelined", "ring")
 
 
@@ -628,6 +631,23 @@ def ring_slots_from_env() -> int:
     return v
 
 
+def mesh_ways_from_env() -> int:
+    """The mesh axis size (GUBER_MESH_WAYS — the deployment-mode
+    spelling for "shards mapped onto mesh axes"; GUBER_TPU_NUM_SHARDS
+    stays as the geometry-level alias).  Returns 0 when unset so the
+    caller can defer to the alias; a SET value must be >= 1 — a zero or
+    negative mesh is a config mistake rejected at startup, and a count
+    past the attached device set is rejected when the mesh is built
+    (parallel/mesh.make_mesh names the shortfall)."""
+    raw = _env("GUBER_MESH_WAYS")
+    if not raw:
+        return 0
+    v = _env_int("GUBER_MESH_WAYS", 0)
+    if v < 1:
+        raise ValueError(f"GUBER_MESH_WAYS must be >= 1, got {raw!r}")
+    return v
+
+
 def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
     """Build a DaemonConfig from GUBER_* env vars (config.go:253-459)."""
     if config_file:
@@ -641,13 +661,25 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         global_sync_wait_s=_env_float_s("GUBER_GLOBAL_SYNC_WAIT", DEFAULT_BATCH_WAIT_S),
         global_batch_limit=_env_int("GUBER_GLOBAL_BATCH_LIMIT", DEFAULT_BATCH_LIMIT),
     )
-    device = DeviceConfig(
-        num_slots=_env_int("GUBER_TPU_NUM_SLOTS", 65_536),
-        ways=_env_int("GUBER_TPU_WAYS", 8),
-        batch_size=_env_int("GUBER_TPU_BATCH_SIZE", 1024),
-        num_shards=_env_int("GUBER_TPU_NUM_SHARDS", 1),
-        platform=os.environ.get("GUBER_TPU_PLATFORM") or None,
+    num_shards = mesh_ways_from_env() or _require_min(
+        "GUBER_TPU_NUM_SHARDS", _env_int("GUBER_TPU_NUM_SHARDS", 1), 1
     )
+    try:
+        device = DeviceConfig(
+            num_slots=_env_int("GUBER_TPU_NUM_SLOTS", 65_536),
+            ways=_env_int("GUBER_TPU_WAYS", 8),
+            batch_size=_env_int("GUBER_TPU_BATCH_SIZE", 1024),
+            num_shards=num_shards,
+            platform=os.environ.get("GUBER_TPU_PLATFORM") or None,
+        )
+    except ValueError as e:
+        # Name the env surface in the startup rejection: an invalid
+        # shard count (slots not divisible by ways*shards) must fail
+        # here, not deep inside MeshBackend construction.
+        raise ValueError(
+            "mesh/device geometry invalid (GUBER_MESH_WAYS, "
+            f"GUBER_TPU_NUM_SLOTS, GUBER_TPU_WAYS): {e}"
+        ) from None
     tls: Optional[TLSConfig] = None
     if _env("GUBER_TLS_CERT") or _env("GUBER_TLS_CA"):
         tls = TLSConfig(

@@ -554,6 +554,23 @@ class Daemon:
                 self.metrics.global_cache_occupancy.set(
                     self.service.global_engine.cache_occupancy()
                 )
+            # Per-shard mesh gauges (docs/architecture.md): occupancy
+            # skew and ring sequence words, refreshed at scrape like
+            # the aggregate occupancy above.
+            shard_occ = getattr(
+                self.service.backend, "shard_occupancy", None
+            )
+            if shard_occ is not None:
+                for s, occ in enumerate(shard_occ()):
+                    self.metrics.shard_occupancy.labels(
+                        shard=str(s)
+                    ).set(occ)
+            fp = self.fastpath
+            if fp is not None and fp._ring is not None:
+                for s, word in enumerate(fp._ring.seq_shards):
+                    self.metrics.shard_ring_seq.labels(
+                        shard=str(s)
+                    ).set(word)
             # Per-peer rolling error windows (the HealthCheck signal,
             # peer_client.last_errors) as scrape-time gauges.
             for peer in (
@@ -625,6 +642,11 @@ class Daemon:
                 "not_persisted": be.not_persisted,
                 "occupancy": be.occupancy(),
             }
+            # Mesh backends: the per-shard skew view (docs/ring.md's
+            # per-shard seq rides the fastpath `ring` block below).
+            shard_occ = getattr(be, "shard_occupancy", None)
+            if shard_occ is not None:
+                out["backend"]["shard_occupancy"] = shard_occ()
             out["inflight_checks"] = s._inflight_checks
             out["global"] = {
                 "async_sends": s.global_mgr.async_sends,

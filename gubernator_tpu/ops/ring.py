@@ -31,6 +31,14 @@ the smallest compiled slot tier so XLA never sees a new shape
 The k=1 block is semantically `apply_batch_packed_q` plus the sequence
 word; the differential suite pins ring mode bit-identical to the
 classic drain (tests/test_differential.py, scripts/ring_smoke.py).
+
+The SAME scan body serves the multi-chip mesh: `ring_step_impl` is the
+per-shard local function of the shard_map-wrapped mesh ring step
+(parallel/sharded.make_mesh_ring_step), which lifts the request block to
+int64[k, 12, n_shards, B] over the sharded grid table and packs a
+PER-SHARD monotone sequence word (int64[n_shards]) alongside the
+responses — so the mesh-ring ≡ single-ring-per-shard equivalence holds
+by construction, not by parallel maintenance of two kernels.
 """
 from __future__ import annotations
 

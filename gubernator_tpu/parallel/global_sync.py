@@ -19,6 +19,17 @@ RPC loops (sendHits + broadcastPeers):
     hits=0 read  broadcast rows              (broadcastPeers re-read :214-217)
     all_gather   rows -> every cache shard  (UpdatePeerGlobals, :464-479)
 
+The DEFAULT sync collective (make_global_sync_step_psum) collapses the
+first step further: because the host pending dict already merged
+duplicate keys and the chunk builder gives each key a globally unique
+(owner, lane) slot, hit aggregation is ONE `psum` over the shard axis —
+no all_to_all, no device-side sort/segment merge.  Intra-mesh "peers"
+never touch the network: UpdatePeerGlobals between shards IS the
+all_gather, and the RPC plane (PeerClient) is engaged only for
+cross-daemon peers (service._engine_synced) — the hybrid ring topology
+where daemon-level arcs of the consistent-hash ring map to meshes and
+mesh-level arcs map to shards.
+
 One deliberate deviation from the reference: the owner device also serves
 GLOBAL reads from its replicated cache rather than answering authoritatively
 (reference gubernator.go:272-283 answers authoritatively on the owner node).
@@ -155,6 +166,91 @@ def make_global_sync_step(mesh, ways: int):
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
+def make_global_sync_step_psum(mesh, ways: int):
+    """The single-collective form of the sync step: hit aggregation is
+    ONE `psum` over the shard axis instead of an all_to_all followed by
+    an O(B log B) sort + segment-sum merge (arXiv 2602.11741's framing:
+    on a mesh, GLOBAL coordination should cost one collective, not a
+    routing exchange plus a device-side merge).
+
+    It leans on a host invariant the a2a step doesn't need: the engine's
+    pending dict already merged duplicate keys (global.go:87-95 applied
+    at queue time), and `_build_chunks` allocates each key ONE
+    (dst, lane) slot globally — so a key occupies exactly one source
+    shard's grid and every other source holds zeros there.  The psum of
+    the per-source [n_dst, D] grids is then the full merged delta on
+    every shard with no duplicate handling at all; each shard slices its
+    own row (`axis_index`), applies it to its auth shard, and the
+    broadcast rows all_gather into the replicated cache exactly as in
+    the a2a step.  Differentially pinned bit-identical to the a2a step
+    (tests/test_differential.py)."""
+
+    def _local(auth: SlotTable, cache: SlotTable, delta: DeltaGrid, now):
+        d = DeltaGrid(*[a[0] for a in delta])  # local [n_dst, D]
+        # sendHits, as ONE collective: per-source grids are disjoint by
+        # host construction, so the sum IS the merge (bool fields ride
+        # as int32 — psum is an add reduction).
+        merged = DeltaGrid(*[
+            jax.lax.psum(
+                a.astype(jnp.int32) if a.dtype == jnp.bool_ else a,
+                SHARD_AXIS,
+            )
+            for a in d
+        ])
+        me = jax.lax.axis_index(SHARD_AXIS)
+        mine = DeltaGrid(*[a[me] for a in merged])  # this shard's [D] row
+        key = mine.key_hash
+        b2 = key.shape[0]
+        act = key != 0
+        batch = DeviceBatchJ(
+            key_hash=key,
+            hits=mine.hits,
+            limit=mine.limit,
+            duration=mine.duration,
+            algo=mine.algo,
+            burst=mine.burst,
+            reset_remaining=jnp.zeros((b2,), dtype=bool),
+            is_greg=mine.is_greg != 0,
+            greg_expire=mine.greg_expire,
+            greg_duration=mine.greg_duration,
+            active=act,
+            use_cached=jnp.zeros((b2,), dtype=bool),
+        )
+        # Owner applies the aggregated hits (server side of sendHits).
+        auth, _ = apply_batch_impl(auth, batch, now, ways=ways)
+        # Broadcast status is a hits=0 re-read (global.go:211-217).
+        auth, resp0 = apply_batch_impl(
+            auth, batch._replace(hits=jnp.zeros((b2,), dtype=jnp.int64)),
+            now, ways=ways,
+        )
+        rows = CachedRows(
+            key_hash=jnp.where(act, key, 0),
+            algo=batch.algo,
+            limit=resp0.limit,
+            remaining=resp0.remaining,
+            status=resp0.status,
+            reset_time=resp0.reset_time,
+        )
+        # UpdatePeerGlobals to every shard: all_gather the authoritative
+        # rows and upsert them into this device's cache shard.
+        gathered = CachedRows(
+            *[
+                jax.lax.all_gather(a, SHARD_AXIS).reshape(-1)
+                for a in rows
+            ]
+        )
+        cache = store_cached_rows_impl(cache, gathered, now, ways=ways)
+        return auth, cache
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
 @dataclass
 class _Pending:
     """One key's queued hits since the last sync (global.go:87-95)."""
@@ -199,11 +295,18 @@ class GlobalEngine:
         backend: MeshBackend,
         delta_slots: int = 256,
         batch_limit: int = 1000,
+        collective: str = "psum",
     ) -> None:
+        if collective not in ("psum", "a2a"):
+            raise ValueError(
+                f"unknown sync collective {collective!r}; expected "
+                "'psum' or 'a2a'"
+            )
         self.b = backend
         self.n = backend.cfg.num_shards
         self.delta_slots = delta_slots
         self.batch_limit = batch_limit
+        self.collective = collective
         self.clock = backend.clock
         # Replicated serving table: its OWN slot budget
         # (DeviceConfig.global_cache_slots; default = num_slots, which
@@ -227,7 +330,15 @@ class GlobalEngine:
         # Same packed sharded step as the backend hot path, run on the
         # cache table (single-transfer in and out).
         self._ingest = backend._step_packed
-        self._sync_step = make_global_sync_step(backend.mesh, backend.cfg.ways)
+        # Default sync collective: ONE psum over the shard axis (the
+        # mesh's whole point — hit aggregation over ICI, no device-side
+        # merge).  "a2a" keeps the all_to_all + sort/segment form as the
+        # differential reference (tests pin the two bit-identical).
+        self._sync_step = (
+            make_global_sync_step_psum(backend.mesh, backend.cfg.ways)
+            if collective == "psum"
+            else make_global_sync_step(backend.mesh, backend.cfg.ways)
+        )
         self._lock = threading.Lock()  # cache_table + pending + metrics
         self.pending: Dict[str, _Pending] = {}
         # Metrics (global.go:48-57 async/broadcast durations + counts).
@@ -533,12 +644,18 @@ class GlobalEngine:
 
         n, D = self.n, self.delta_slots
         chunks: List[DeltaGrid] = []
-        fill: List[np.ndarray] = []  # [n, n] lane counters per chunk
+        # Lane counters are per (chunk, DST) — shared across sources —
+        # so every key gets a GLOBALLY unique (dst, lane) slot within a
+        # chunk.  The psum step's whole premise is that the per-source
+        # grids are disjoint (the sum IS the merge); the a2a step
+        # handles this layout too (its sort/segment merge degenerates to
+        # a permutation), so one builder serves both collectives.
+        fill: List[np.ndarray] = []  # [n_dst] lane counters per chunk
 
         def new_chunk() -> DeltaGrid:
             g = zero_delta_grid(n, D)
             chunks.append(g)
-            fill.append(np.zeros((n, n), dtype=np.int64))
+            fill.append(np.zeros(n, dtype=np.int64))
             return g
 
         def fill_lane(ci: int, lane: int, h64, p: _Pending, is_greg, ge, gd):
@@ -553,12 +670,12 @@ class GlobalEngine:
             g.is_greg[src, dst, lane] = is_greg
             g.greg_expire[src, dst, lane] = ge
             g.greg_duration[src, dst, lane] = gd
-            fill[ci][src, dst] = lane + 1
+            fill[ci][dst] = lane + 1
 
         for key, p in pending.items():
             r = p.req
             h64 = key_hash64(key)
-            src, dst = p.src_dev, int(shard_of_hash(h64, n))
+            dst = int(shard_of_hash(h64, n))
             is_greg = has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN)
             ge = gd = 0
             if is_greg:
@@ -571,7 +688,7 @@ class GlobalEngine:
                     continue
             while True:
                 for ci in range(len(chunks)):
-                    lane = int(fill[ci][src, dst])
+                    lane = int(fill[ci][dst])
                     if lane < D:
                         fill_lane(ci, lane, h64, p, is_greg, ge, gd)
                         break

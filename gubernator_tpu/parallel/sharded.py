@@ -138,6 +138,41 @@ def packed_grid_rounds_to_host(round_resps) -> List[Dict[str, np.ndarray]]:
     ]
 
 
+def make_mesh_ring_step(mesh, ways: int):
+    """The ring drain's bounded multi-round scan, lifted to the sharded
+    grid table (docs/ring.md):
+
+        table'[n·S], resps[k, n, 9, B], seq'[n] =
+            mesh_ring_step(table[n·S], qs[k, 12, n, B], nows[k], seq[n])
+
+    Each shard runs ops/ring.ring_step_impl — the EXACT single-table
+    scan body — on its local [k, 12, B] request block, so mesh-ring ≡
+    one ring per shard by construction.  The table is donated (the loop
+    updates each shard's HBM block in place); the per-shard sequence
+    words are NOT (the double-buffered response protocol must still
+    fetch iteration N's words after iteration N+1 dispatched with them
+    as input — the same keep rule as the single-device seq).  The hot
+    path needs NO collectives: routing already placed every lane on its
+    owner shard, so the scan compiles to independent per-device loops
+    over ICI-free local work."""
+    from gubernator_tpu.ops.ring import ring_step_impl
+
+    def _local(table: SlotTable, qs, nows, seq):
+        t2, resps, s2 = ring_step_impl(
+            table, qs[:, :, 0, :], nows, seq[0], ways=ways
+        )
+        return t2, resps[:, None], s2[None]
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None, None, SHARD_AXIS), P(),
+                  P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def make_sharded_row_op(mesh, ways: int, impl, row_type):
     """Shared factory for row-upsert collectiveless steps: each shard
     applies `impl` to its routed [B] block of `row_type` rows.  Instances:
@@ -260,6 +295,11 @@ class MeshBackend(PersistenceHost):
         self._tiers = resolve_tiers(cfg)
         # Batch input sharding: [12, n, B] split on the shard axis (dim 1).
         self._psharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        # Ring request-block sharding: [k, 12, n, B] split on dim 2.
+        self._qsharding = NamedSharding(
+            self.mesh, P(None, None, SHARD_AXIS)
+        )
+        self._ring_step = make_mesh_ring_step(self.mesh, cfg.ways)
         self._cached_store = make_sharded_row_op(
             self.mesh, cfg.ways, store_cached_rows_impl, CachedRows
         )
@@ -272,13 +312,51 @@ class MeshBackend(PersistenceHost):
         self.over_limit = 0
         self.not_persisted = 0
 
+    # -- ring drain discipline (runtime/ring.py; docs/ring.md) -----------
     def ring_supported(self) -> bool:
-        """The ring drain discipline (runtime/ring.py) scans a single
-        donated SlotTable; the sharded grid table would need a
-        shard_map-wrapped scan kernel.  Until that lands, mesh services
-        fall back to the depth-k pipelined discipline (docs/ring.md's
-        fallback rule) — step_rounds_begin already overlaps fetches."""
-        return False
+        """The mesh serves ring mode natively: make_mesh_ring_step is the
+        shard_map lift of the single-table scan, so GUBER_SERVE_MODE=ring
+        on a mesh service arms a real device loop instead of falling back
+        to the pipelined discipline (the pre-mesh-ring fallback rule is
+        retired; docs/ring.md)."""
+        return True
+
+    def ring_q_shape(self, tb: int) -> tuple:
+        """Per-round request-slot shape at batch tier `tb` — the grid
+        form [12, n_shards, tb] (the ring runner builds blocks of
+        (slot_tier,) + this shape)."""
+        return (12, self.cfg.num_shards, tb)
+
+    def ring_pack_round(self, db, tb: int) -> np.ndarray:
+        """One [n, B] grid DeviceBatch -> its ring slot [12, n, tb]."""
+        return pack_grid_batch(db)[:, :, :tb]
+
+    def ring_seq_init(self):
+        """Fresh per-shard sequence words (int64[n], sharded)."""
+        return jax.device_put(
+            np.zeros(self.cfg.num_shards, dtype=np.int64),
+            self._bsharding,
+        )
+
+    def ring_step_dispatch(self, qs: np.ndarray, nows: np.ndarray, seq):
+        """Dispatch one bounded mesh ring iteration — `qs`
+        int64[k, 12, n, B] stacked grid rounds — under the lock (the
+        same single-writer section as every other table mutation).
+        Returns the un-synced device (responses[k, n, 9, B], per-shard
+        seq words); the ring runner fetches them off the request path."""
+        import time as time_mod
+
+        t_start = time_mod.monotonic()
+        with self._lock:
+            batch = jax.device_put(qs, self._qsharding)
+            self.table, resps, seq = self._ring_step(
+                self.table, batch, np.asarray(nows, dtype=np.int64), seq
+            )
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time_mod.monotonic() - t_start
+            )
+        return resps, seq
 
     def _add_tally(self, tally) -> None:
         with self._lock:
@@ -776,3 +854,20 @@ class MeshBackend(PersistenceHost):
     def occupancy(self) -> int:
         with self._lock:
             return int(np.asarray(self.table.occupancy()))
+
+    def shard_occupancy(self) -> List[int]:
+        """Live rows PER SHARD (one device reduce + one [n] fetch) — the
+        skew view the aggregate occupancy() hides: hash routing spreads
+        keys uniformly in expectation, but a production key set can pile
+        onto one shard, and only the per-shard counts show it
+        (/debug/vars `shard_occupancy`, gubernator_shard_occupancy)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            counts = jnp.sum(
+                self.table.key.reshape(
+                    self.cfg.num_shards, self.local_slots
+                ) != 0,
+                axis=1,
+            )
+        return [int(c) for c in np.asarray(counts)]

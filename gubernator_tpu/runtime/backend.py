@@ -496,10 +496,22 @@ class DeviceBackend(PersistenceHost):
 
     # -- ring drain discipline (runtime/ring.py) -------------------------
     def ring_supported(self) -> bool:
-        """The ring loop scans a single donated SlotTable; the mesh
-        backend overrides this to False (its table is shard_map-sharded;
-        the fast lane falls back to the pipelined discipline there)."""
+        """Single-table backends scan ops/ring.ring_step directly; the
+        mesh backend serves the same protocol through its shard_map lift
+        (parallel/sharded.make_mesh_ring_step) — both report True, and
+        the RingBackend shapes its blocks via ring_q_shape()."""
         return True
+
+    def ring_q_shape(self, tb: int) -> tuple:
+        """Per-round request-slot shape at batch tier `tb`: [12, tb]
+        (pack_batch_q row order).  The mesh backend returns the grid
+        form [12, n_shards, tb]; the ring runner is layout-agnostic —
+        it only stacks rounds along a leading slot axis."""
+        return (12, tb)
+
+    def ring_pack_round(self, db, tb: int) -> np.ndarray:
+        """One [B] DeviceBatch -> its ring slot layout [12, tb]."""
+        return pack_batch_q(db)[:, :tb]
 
     def ring_seq_init(self):
         """A fresh device-resident sequence word for a RingBackend."""

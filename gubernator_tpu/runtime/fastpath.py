@@ -461,9 +461,12 @@ class FastPath:
     "ring" hands plain merges to the device-resident serving loop
     (runtime/ring.py) — packed straight into ring slot layout, fetched
     by the ring runner off the request path — with locked cascade/
-    store merges and sketch readbacks riding the runner as FIFO host
-    jobs.  Ring requires a single-table backend; otherwise (and on a
-    broken ring) the pipelined discipline is the fallback."""
+    store merges, sketch readbacks, and engine (GLOBAL collective)
+    readbacks riding the runner as FIFO host jobs.  Both the
+    single-table and the mesh backend serve ring mode (the mesh via the
+    shard_map ring step, parallel/sharded.make_mesh_ring_step); only a
+    backend without ring support — or a broken ring — falls back to the
+    pipelined discipline."""
 
     def __init__(self, service, max_inflight: int = 1,
                  sparse_limit: int = 64,
@@ -487,8 +490,9 @@ class FastPath:
         # Drain discipline (docs/ring.md): classic = strict depth-1,
         # pipelined = depth-k fetch overlap, ring = the device-resident
         # serving loop (runtime/ring.py) with NO blocking fetch on the
-        # request path.  Ring needs a single-table backend (the mesh
-        # grid falls back to pipelined — ring_supported()).
+        # request path.  Single-table AND mesh backends both serve ring
+        # mode; only a backend without ring support degrades to
+        # pipelined (docs/ring.md's fallback rule — no longer the mesh).
         self.serve_mode = serve_mode  # requested
         self._ring = None
         if serve_mode == "classic":
@@ -1188,8 +1192,7 @@ class FastPath:
                 )
         resps, want_sync = engine.serve_packed(rounds, pend)
 
-        def fetch() -> List[Tuple[np.ndarray, ...]]:
-            self.blocking_fetches["engine"] += 1
+        def fetch_body() -> List[Tuple[np.ndarray, ...]]:
             host = packed_grid_rounds_to_host(resps)
 
             mt = len(h_all)
@@ -1223,6 +1226,26 @@ class FastPath:
                     rem_u[lo:hi][inv], rst_u[lo:hi][inv],
                 ))
             return outs
+
+        # Ring discipline: the engine readback (and a triggered sync's
+        # collective + write-through) runs on the ring runner, FIFO with
+        # the ring iterations — the mesh request path stays fetch-free
+        # even for GLOBAL lanes (the sketch-lane pattern).
+        wait_body = None
+        ring = self._ring_live()
+        if ring is not None:
+            from gubernator_tpu.runtime.ring import RingClosedError
+
+            try:
+                wait_body = ring.submit_host(fetch_body)
+            except RingClosedError:
+                wait_body = None
+
+        def fetch() -> List[Tuple[np.ndarray, ...]]:
+            if wait_body is not None:
+                return wait_body()
+            self.blocking_fetches["engine"] += 1
+            return fetch_body()
 
         return fetch
 
@@ -1929,18 +1952,23 @@ class FastPath:
             is_greg=is_greg, greg_expire=ge, greg_duration=gd,
             use_cached=use_cached,
         )
-        # Ring-eligible merge (plain, single-shard): scatter the parsed
-        # columns STRAIGHT into ring slot layout — no DeviceBatch
-        # objects exist between the C++ parse and the device loop.
+        # Ring-eligible merge (plain): scatter the parsed columns
+        # STRAIGHT into ring slot layout — no DeviceBatch objects exist
+        # between the C++ parse and the device loop.  On a mesh backend
+        # the scatter targets shard-grid slots ([n_shards, tb] per field
+        # row), so the columns land exactly where the shard_map ring
+        # step reads them.
         ring = (
             self._ring_live()
-            if (plan is None and not do_store and n_shards == 1)
+            if (plan is None and not do_store)
             else None
         )
         ring_qs = None
         if ring is not None:
             ring_qs, order, bounds = _build_rounds_q(
-                values, rnd, lane, n_rounds, backend._tiers
+                values, rnd, lane, n_rounds, backend._tiers,
+                sh_all=sh_all if n_shards > 1 else None,
+                n_shards=n_shards,
             )
             rounds = [_QRound(ring_qs[i, 10] != 0)
                       for i in range(n_rounds)]
@@ -2389,29 +2417,33 @@ class _QRound:
         self.active = active
 
 
-def _build_rounds_q(values, rnd, lane, n_rounds, tiers):
+def _build_rounds_q(values, rnd, lane, n_rounds, tiers,
+                    sh_all=None, n_shards=1):
     """Scatter columnar values STRAIGHT into ring slot layout — one
-    int64[k, 12, tb] stacked request block (pack_batch_q row order) —
-    skipping DeviceBatch assembly entirely: the C++ parser's columns
-    land in ring slots with one scatter per field (single-shard only;
-    the ring discipline requires it).  Returns (qs, order, bounds) with
-    order/bounds exactly as _build_rounds computes them."""
+    int64[k, 12, tb] stacked request block (pack_batch_q row order), or
+    int64[k, 12, n_shards, tb] on a mesh backend, where the parser's
+    columns land in shard-grid slots with one scatter per field —
+    skipping DeviceBatch assembly entirely.  Returns (qs, order, bounds)
+    with order/bounds exactly as _build_rounds computes them."""
     ok = np.flatnonzero(rnd >= 0)
     order = ok[np.argsort(rnd[ok], kind="stable")]
     bounds = np.searchsorted(rnd[order], np.arange(n_rounds + 1))
-    # Lanes fill contiguously from 0 per round (assign_rounds), so the
-    # max per-round count bounds the highest used lane — the same
+    # Lanes fill contiguously from 0 per (round, shard) (assign_rounds),
+    # so the max assigned lane bounds the highest used one — the same
     # compiled-tier rule as backend.tier_of.
-    occ = int((bounds[1:] - bounds[:-1]).max()) if n_rounds else 0
+    occ = int(lane[ok].max()) + 1 if len(ok) else 0
     tb = next((t for t in tiers if occ <= t), tiers[-1])
-    qs = np.zeros((n_rounds, 12, tb), dtype=np.int64)
+    grid = n_shards > 1
+    shape = (n_rounds, 12, n_shards, tb) if grid else (n_rounds, 12, tb)
+    qs = np.zeros(shape, dtype=np.int64)
     for r_idx in range(n_rounds):
         sel = order[bounds[r_idx]:bounds[r_idx + 1]]
         l_m = lane[sel]
         q = qs[r_idx]
+        idx = (sh_all[sel], l_m) if grid else (l_m,)
         for f, v in values.items():
-            q[_Q_ROW[f], l_m] = v[sel]
-        q[_Q_ROW["active"], l_m] = 1
+            q[(_Q_ROW[f],) + idx] = v[sel]
+        q[(_Q_ROW["active"],) + idx] = 1
     return qs, order, bounds
 
 

@@ -379,6 +379,26 @@ class Metrics:
             "(mesh GlobalEngine; sized by global_cache_slots).",
             registry=r,
         )
+        # Per-shard mesh observability (docs/architecture.md mesh
+        # deployment mode): the aggregate occupancy hides skew — a
+        # production key set piling onto one shard is visible only
+        # per-shard, and a lagging per-shard ring sequence word means
+        # that shard's loop dropped or replayed a block.
+        self.shard_occupancy = Gauge(
+            "gubernator_shard_occupancy",
+            "Occupied slots per mesh shard (mesh backends only; skewed "
+            "shards show here while the aggregate looks healthy).",
+            ["shard"],
+            registry=r,
+        )
+        self.shard_ring_seq = Gauge(
+            "gubernator_shard_ring_seq",
+            "Per-shard ring sequence word at the last fetched iteration "
+            "(ring mode; every shard must match the host mirror — see "
+            "docs/ring.md's sequence protocol).",
+            ["shard"],
+            registry=r,
+        )
 
     def note_check_error(self, error: str, n: int = 1) -> None:
         """Count a check error AND feed the flight recorder's

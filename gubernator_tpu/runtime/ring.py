@@ -37,6 +37,13 @@ to the depth-k pipelined discipline (docs/ring.md's fallback rule).
 `close()` finishes the in-flight iteration (its device effects already
 happened), fails never-started jobs, and joins the runner.
 
+The runner is LAYOUT-AGNOSTIC: a slot is whatever the backend's
+`ring_q_shape(tb)` says — int64[12, B] on a single-table backend,
+int64[12, n_shards, B] on the mesh (parallel/sharded.make_mesh_ring_step,
+whose per-shard sequence words all advance by the consumed tier and are
+verified against the host mirror element-wise).  Blocks stack rounds
+along the leading slot axis either way.
+
 On TPU backends with Pallas DMA support the same protocol maps onto a
 device-resident loop with host-pinned rings (docs/ring.md); this runner
 is the portable host-driven form and the semantic reference for it.
@@ -155,9 +162,13 @@ class RingBackend:
         self.job_timeout_s = job_timeout_s
         # Host mirror of the device sequence word (ops/ring.py): advances
         # by the consumed TIER (padding slots included) per iteration;
-        # the fetch verifies the device word agrees.
+        # the fetch verifies the device word agrees.  On a mesh backend
+        # the device word is PER SHARD (int64[n]) and every shard must
+        # agree with the mirror; the latest fetched words are kept for
+        # /debug/vars + the gubernator_shard_ring_seq gauges.
         self.seq = 0
         self.seq_mismatches = 0
+        self.seq_shards: list = []
         # Observability (debug_vars + the ring metrics).
         self.iterations = 0
         self.rounds_consumed = 0
@@ -186,20 +197,21 @@ class RingBackend:
         fast lane scatters its columns straight into the layout instead
         (fastpath._build_rounds_q) — no DeviceBatch objects exist on
         that path."""
-        from gubernator_tpu.runtime.backend import pack_batch_q, tier_of
+        from gubernator_tpu.runtime.backend import tier_of
 
         be = self._backend
         if not rounds:
             return lambda: []
         tb = max(tier_of(db.active, be._tiers) for db in rounds)
         return self.submit_q(
-            np.stack([pack_batch_q(db)[:, :tb] for db in rounds])
+            np.stack([be.ring_pack_round(db, tb) for db in rounds])
         )
 
     def submit_q(self, qs: np.ndarray) -> Callable[[], list]:
         """Queue one merge's request block — int64[k, 12, B] rounds
-        already in ring slot layout — into `k` ring slots; returns a
-        zero-arg wait producing the per-round host response dicts
+        already in ring slot layout (int64[k, 12, n, B] grid slots on a
+        mesh backend) — into `k` ring slots; returns a zero-arg wait
+        producing the per-round host response dicts
         (packed_rounds_to_host shape).  Blocks while the ring is full —
         the backpressure the slot-wait metrics measure.
 
@@ -293,6 +305,7 @@ class RingBackend:
         return {
             "slots": self.slots,
             "seq": self.seq,
+            "seq_shards": list(self.seq_shards),
             "seq_mismatches": self.seq_mismatches,
             "iterations": self.iterations,
             "rounds_consumed": self.rounds_consumed,
@@ -315,7 +328,10 @@ class RingBackend:
         resps = None
         for tb in self._backend._tiers:
             for t in self._tiers:
-                qs = np.zeros((t, 12, tb), dtype=np.int64)
+                qs = np.zeros(
+                    (t,) + tuple(self._backend.ring_q_shape(tb)),
+                    dtype=np.int64,
+                )
                 nows = np.zeros(t, dtype=np.int64)
                 resps, self._seq_dev = self._backend.ring_step_dispatch(
                     qs, nows, self._seq_dev
@@ -354,13 +370,18 @@ class RingBackend:
         be = self._backend
         k = sum(int(job.qs.shape[0]) for job in block)
         tier = ring_tier_of(k, self._tiers)
-        tb = max(int(job.qs.shape[2]) for job in block)
-        qs = np.zeros((tier, 12, tb), dtype=np.int64)
+        # Slot layout is backend-defined (ring_q_shape): [12, B] single
+        # table, [12, n, B] mesh grid.  The inner dims are constant
+        # across jobs; only the trailing batch tier varies.
+        tb = max(int(job.qs.shape[-1]) for job in block)
+        inner = tuple(block[0].qs.shape[1:-1])
+        qs = np.zeros((tier,) + inner + (tb,), dtype=np.int64)
         off_q = 0
         for job in block:
-            jk, _, jtb = job.qs.shape
+            jk = int(job.qs.shape[0])
+            jtb = int(job.qs.shape[-1])
             # Narrower jobs pad with zero lanes (inactive by layout).
-            qs[off_q:off_q + jk, :, :jtb] = job.qs
+            qs[off_q:off_q + jk, ..., :jtb] = job.qs
             off_q += jk
         now = np.int64(be.clock.millisecond_now())
         nows = np.full(tier, now, dtype=np.int64)
@@ -449,7 +470,13 @@ class RingBackend:
             for job in block:
                 job.publish(error=e)
             return
-        if int(seq_host) != want_seq:
+        # Scalar word on a single-table backend; int64[n] per-shard
+        # words on the mesh — EVERY shard's word must agree with the
+        # host mirror (a lagging shard means its loop dropped or
+        # replayed a block).
+        seq_words = np.asarray(seq_host).reshape(-1)
+        self.seq_shards = [int(w) for w in seq_words]
+        if (seq_words != want_seq).any():
             # The device loop and the host mirror disagree — responses
             # may be misattributed.  Record loudly; the differential
             # suite asserts this never fires.
@@ -463,7 +490,7 @@ class RingBackend:
             # built at the job's tier (tally_from_rounds would
             # broadcast-fail on wider rows; the padded lanes are
             # inactive by construction, so nothing real is dropped).
-            w = int(job.qs.shape[2])
+            w = int(job.qs.shape[-1])
             job.publish(result=[
                 _packed_resp_dict(host[off + i][..., :w])
                 for i in range(n)

@@ -1,0 +1,281 @@
+"""Mesh-ring CPU smoke: mesh ring vs mesh classic equivalence + zero
+request-path fetches over an 8-virtual-device mesh.
+
+The mesh edition of scripts/ring_smoke.py (PR 9 acceptance): ~10k mixed
+checks (token/leaky, bursts, RESET_REMAINING, valid Gregorian,
+zero/negative hits, duplicate keys, a GLOBAL slice with per-key-constant
+params served by the collective GlobalEngine) through the compiled fast
+lane twice on an 8-shard MeshBackend — once at GUBER_SERVE_MODE=classic
+and once in ring mode — under a frozen clock with a quiesced collective
+sync cadence (a mid-run sync makes GLOBAL reads stale BY CONTRACT,
+which would inject schedule noise into the comparison; sync equivalence
+is pinned by the psum-vs-broadcast differential).  Pass criteria:
+
+  1. responses and final table rows bit-identical across modes;
+  2. the ring run performed ZERO blocking device->host fetches on the
+     request path — machinery, sketch, AND engine lanes (the mesh
+     GLOBAL readback rides the ring runner as a host job);
+  3. the mesh ring actually iterated, every shard's sequence word
+     agreed with the host mirror (0 mismatches), and per-shard
+     occupancy is reported and consistent with the aggregate.
+
+On failure the armed flight recorder's ring is dumped to
+mesh-smoke-dumps/ for the CI artifact.  Runs in the CI matrix
+(JAX_PLATFORMS=cpu + 8 virtual devices); exit 0 = pass.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+N_SHARDS = 8
+N_WORKERS = 6
+BATCHES_PER_WORKER = 24
+KEYS_PER_WORKER = 8  # k0..k5 exact mix, k6..k7 GLOBAL constant-param
+
+
+def build_schedules():
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    rng = random.Random(4321)
+    schedules = []
+    total = 0
+    for w in range(N_WORKERS):
+        payloads = []
+        for _ in range(BATCHES_PER_WORKER):
+            reqs = []
+            glob_used = set()
+            for _ in range(rng.randrange(40, 90)):
+                if rng.random() < 0.15 and len(glob_used) < 2:
+                    # GLOBAL slice: constant params, at most ONE
+                    # occurrence per key per payload — the collective
+                    # engine aggregates intra-batch duplicates by
+                    # design (parallel/global_sync.GlobalEngine.check).
+                    k = 6 + rng.randrange(2)
+                    if k in glob_used:
+                        continue
+                    glob_used.add(k)
+                    reqs.append(pb.RateLimitReq(
+                        name=f"msmoke{w}",
+                        unique_key=f"k{k}",
+                        hits=rng.choice([0, 1, 1, 2]),
+                        limit=200 + 100 * (k % 2),
+                        duration=60_000,
+                        algorithm=k % 2,
+                        behavior=2,  # GLOBAL
+                        burst=250 if k % 2 == 0 else 0,
+                    ))
+                    continue
+                behavior = 0
+                duration = rng.choice([60_000, 60_000, 1_000])
+                if rng.random() < 0.06:
+                    behavior |= 8  # RESET_REMAINING
+                if rng.random() < 0.04:
+                    behavior |= 4  # DURATION_IS_GREGORIAN
+                    duration = rng.choice([1, 4])
+                reqs.append(pb.RateLimitReq(
+                    name=f"msmoke{w}",
+                    unique_key=f"k{rng.randrange(6)}",
+                    hits=rng.choice([0, 1, 1, 1, 2, 5, -1]),
+                    limit=rng.choice([50, 200, 1000]),
+                    duration=duration,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 60]),
+                ))
+            total += len(reqs)
+            payloads.append(
+                pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+            )
+        schedules.append(payloads)
+    return schedules, total
+
+
+def run_mode(mode: str, schedules, clock):
+    from gubernator_tpu.core.config import (
+        BehaviorConfig,
+        Config,
+        DeviceConfig,
+    )
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.flightrec import FlightRecorder
+    from gubernator_tpu.runtime.metrics import Metrics
+    from gubernator_tpu.runtime.service import Service
+
+    dev = DeviceConfig(
+        num_slots=N_SHARDS * 8 * 256, ways=8, batch_size=256,
+        num_shards=N_SHARDS,
+    )
+
+    async def scenario():
+        metrics = Metrics()
+        fr = FlightRecorder(metrics=metrics, dump_dir="mesh-smoke-dumps")
+        metrics.flightrec = fr
+        fr.start()
+        svc = Service(
+            Config(
+                device=dev,
+                behaviors=BehaviorConfig(global_sync_wait_s=3600.0),
+            ),
+            clock=clock, metrics=metrics,
+        )
+        await svc.start()
+        fp = FastPath(svc, serve_mode=mode, ring_slots=8)
+        results: dict = {}
+
+        async def worker(w: int):
+            await asyncio.sleep(w * 0.002)
+            got = []
+            for payload in schedules[w]:
+                raw = await fp.check_raw(payload, peer_rpc=False)
+                assert raw is not None, "fast lane fell back"
+                got.append([
+                    (r.status, r.limit, r.remaining, r.reset_time, r.error)
+                    for r in pb.GetRateLimitsResp.FromString(raw).responses
+                ])
+            results[w] = got
+
+        await asyncio.gather(*(worker(w) for w in range(N_WORKERS)))
+        rows = {}
+        for w in range(N_WORKERS):
+            for k in range(KEYS_PER_WORKER):
+                key = f"msmoke{w}_k{k}"
+                item = svc.backend.get_cache_item(key)
+                rows[key] = (
+                    (item.remaining, item.expire_at, int(item.status),
+                     item.limit, item.duration, int(item.algorithm))
+                    if item is not None else None
+                )
+        dv = fp.debug_vars()
+        shard_occ = svc.backend.shard_occupancy()
+        agg_occ = svc.backend.occupancy()
+        snap = fr.snapshot()
+        await fp.close()
+        await svc.close()
+        await fr.close()
+        return results, rows, dv, shard_occ, agg_occ, snap
+
+    return asyncio.run(scenario())
+
+
+def main() -> int:
+    from gubernator_tpu import native
+    from gubernator_tpu.core import clock as clock_mod
+
+    if not native.available():
+        print("mesh_smoke: SKIP (native library unavailable)")
+        return 0
+
+    schedules, total = build_schedules()
+    print(f"mesh_smoke: {total} checks x 2 serve modes on a "
+          f"{N_SHARDS}-shard mesh")
+    clock_mod.freeze()
+    try:
+        (base_results, base_rows, base_dv, base_shards, base_occ,
+         base_snap) = run_mode(
+            "classic", schedules, clock_mod.default_clock()
+        )
+        (ring_results, ring_rows, ring_dv, ring_shards, ring_occ,
+         ring_snap) = run_mode(
+            "ring", schedules, clock_mod.default_clock()
+        )
+    finally:
+        clock_mod.unfreeze()
+
+    ok = True
+    if ring_results != base_results:
+        for w in base_results:
+            for i, (a, b) in enumerate(
+                zip(base_results[w], ring_results[w])
+            ):
+                if a != b:
+                    print(
+                        f"FAIL: worker {w} batch {i} diverged:\n"
+                        f"  classic: {a[:3]}...\n  ring: {b[:3]}..."
+                    )
+                    break
+        ok = False
+    if ring_rows != base_rows:
+        diff = {
+            k for k in base_rows if base_rows[k] != ring_rows.get(k)
+        }
+        print(f"FAIL: {len(diff)} table rows diverged: {sorted(diff)[:5]}")
+        ok = False
+    ring_stats = ring_dv.get("ring", {})
+    blocking = ring_dv["blocking_fetches"]
+    if ring_dv["effective_serve_mode"] != "ring":
+        print(
+            "FAIL: mesh service fell back to "
+            f"{ring_dv['effective_serve_mode']!r} — the mesh must serve "
+            "ring natively (docs/ring.md)"
+        )
+        ok = False
+    if sum(blocking.values()) != 0:
+        per_check = sum(blocking.values()) / float(total) if total else 0.0
+        print(
+            "FAIL: mesh ring mode performed blocking request-path "
+            f"fetches: {blocking} ({per_check:.4f} per check; must be 0)"
+        )
+        ok = False
+    if base_dv["blocking_fetches"]["mach"] == 0:
+        print("FAIL: classic run counted no machinery fetches — the "
+              "smoke's counter is broken/vacuous")
+        ok = False
+    if ring_stats.get("iterations", 0) < 1:
+        print(f"FAIL: the mesh ring never iterated: {ring_stats}")
+        ok = False
+    if ring_stats.get("seq_mismatches", 0) != 0:
+        print(f"FAIL: per-shard sequence-word mismatches: {ring_stats}")
+        ok = False
+    seq_shards = ring_stats.get("seq_shards", [])
+    if len(seq_shards) != N_SHARDS or len(set(seq_shards)) != 1:
+        print(f"FAIL: inconsistent per-shard seq words: {seq_shards}")
+        ok = False
+    if len(ring_shards) != N_SHARDS or sum(ring_shards) != ring_occ:
+        print(
+            f"FAIL: per-shard occupancy {ring_shards} does not sum to "
+            f"the aggregate {ring_occ}"
+        )
+        ok = False
+    print("mesh_smoke: classic stats "
+          + json.dumps(base_dv["blocking_fetches"]))
+    print("mesh_smoke: ring stats " + json.dumps(ring_stats))
+    print("mesh_smoke: per-shard occupancy " + json.dumps(ring_shards))
+    if ok:
+        print(
+            f"mesh_smoke: OK — {total} checks bit-identical across serve "
+            f"modes on the {N_SHARDS}-shard mesh; ring ran "
+            f"{ring_stats.get('iterations')} iterations + "
+            f"{ring_stats.get('host_jobs')} host jobs with 0 blocking "
+            "request-path fetches; per-shard seq consistent at "
+            f"{seq_shards[:1] and seq_shards[0]}"
+        )
+    else:
+        # Dump both runs' flight-recorder rings for the CI artifact.
+        os.makedirs("mesh-smoke-dumps", exist_ok=True)
+        with open("mesh-smoke-dumps/mesh_smoke_failure.json", "w") as f:
+            json.dump({
+                "classic": {"debug_vars": base_dv, "flightrec": base_snap,
+                            "shard_occupancy": base_shards},
+                "ring": {"debug_vars": ring_dv, "flightrec": ring_snap,
+                         "shard_occupancy": ring_shards},
+            }, f, indent=1, default=str)
+        print("mesh_smoke: FAILED (see mesh-smoke-dumps/)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

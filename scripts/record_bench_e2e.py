@@ -1,12 +1,14 @@
 """Run bench_e2e on the rig and assemble BENCH_E2E_r{N}.json.
 
 Usage: python scripts/record_bench_e2e.py [seconds] [concurrency] [round]
-                                          [suffix] [workload]
+                                          [suffix] [workload] [mesh_shards]
 
 A non-empty `suffix` names a variant artifact (BENCH_E2E_r{N}_{suffix}
 .json) for A/B runs; the GUBER_FASTPATH_SPARSE env var passes through to
 bench_e2e's cluster configs.  `workload` (e.g. zipf:1.2) adds the
 skewed-key owner-share config (bench_e2e --workload; docs/hotkeys.md).
+`mesh_shards` (e.g. 8) adds the mesh deployment-mode serve sweep with
+per-shard occupancy (bench_e2e --mesh-shards; docs/architecture.md).
 """
 import json
 import os
@@ -18,12 +20,15 @@ CONC = sys.argv[2] if len(sys.argv) > 2 else "16"
 ROUND = int(sys.argv[3]) if len(sys.argv) > 3 else 7
 SUFFIX = sys.argv[4] if len(sys.argv) > 4 else ""
 WORKLOAD = sys.argv[5] if len(sys.argv) > 5 else "zipf:1.2"
+MESH_SHARDS = sys.argv[6] if len(sys.argv) > 6 else "0"
 
 try:
     cmd = [sys.executable, "/root/repo/bench_e2e.py", "--seconds",
            SECONDS, "--concurrency", CONC]
     if WORKLOAD:
         cmd += ["--workload", WORKLOAD]
+    if MESH_SHARDS not in ("", "0"):
+        cmd += ["--mesh-shards", MESH_SHARDS]
     out = subprocess.run(
         cmd,
         capture_output=True, text=True, timeout=1800,
@@ -65,6 +70,10 @@ artifact = {
     "harness": (
         f"bench_e2e.py --seconds {SECONDS} --concurrency {CONC}"
         + (f" --workload {WORKLOAD}" if WORKLOAD else "")
+        + (
+            f" --mesh-shards {MESH_SHARDS}"
+            if MESH_SHARDS not in ("", "0") else ""
+        )
     ),
     "platform": (
         "tpu (single chip via axon tunnel)"
@@ -114,7 +123,19 @@ artifact = {
         "checks next to p50/p99 — the single-owner funnel the hot-key "
         "survival plane (docs/hotkeys.md) exists to survive; its "
         "mirroring stays provably inactive here because no owner "
-        "breaches its SLO."
+        "breaches its SLO.  Round-8 addition: the mesh_serve_sweep_* "
+        "configs (--mesh-shards N) re-run the serve-mode A/B on an "
+        "N-shard MESH daemon — the deployment-mode benchmark "
+        "(docs/architecture.md): mesh ring mode must hold "
+        "blocking_fetches_per_check == 0 (engine lane included; GLOBAL "
+        "readbacks and psum syncs ride the ring runner), and the "
+        "mesh_serve_sweep_stages line reports per-shard occupancy, "
+        "per-shard ring sequence words, and the ring slot-wait budget "
+        "term.  On a CPU rig the N virtual devices share one host, so "
+        "mesh absolute throughput is NOT comparable to the single-"
+        "device configs — the claims this artifact supports there are "
+        "the zero-fetch discipline and the per-shard accounting, not a "
+        "speedup."
     ),
     "results": results,
 }

@@ -175,12 +175,17 @@ def test_differential_zipfian_duplicates(kind, frozen_clock):
         frozen_clock.advance(rng.choice([0, 0, 250, 2_000]))
 
 
-def test_differential_global_engine_sync_interleavings(frozen_clock):
+@pytest.mark.parametrize("collective", ["psum", "a2a"])
+def test_differential_global_engine_sync_interleavings(
+    collective, frozen_clock
+):
     """GLOBAL collective engine vs the oracle, with random sync points
     (VERDICT r2 #3): between syncs hits aggregate per key (last request's
     params, summed hits — global.go:87-95); each sync must leave the AUTH
     table bit-identical to the oracle applying the same aggregates at the
-    same frozen time.  Probed with hits=0 reads on both sides."""
+    same frozen time.  Probed with hits=0 reads on both sides.  Runs
+    under BOTH sync collectives — the one-psum default and the
+    all_to_all reference form (parallel/global_sync.py)."""
     from dataclasses import replace as dc_replace
 
     from gubernator_tpu.parallel.global_sync import GlobalEngine
@@ -188,7 +193,7 @@ def test_differential_global_engine_sync_interleavings(frozen_clock):
 
     rng = random.Random(7)
     b = MeshBackend(MESH_DEV, clock=frozen_clock)
-    eng = GlobalEngine(b)
+    eng = GlobalEngine(b, collective=collective)
     oracle = PyRateLimiter(clock=frozen_clock)
     pend = {}  # key -> (last req, summed hits)
     seen = set()
@@ -233,6 +238,63 @@ def test_differential_global_engine_sync_interleavings(frozen_clock):
                 assert g.remaining == want.remaining, ctx
                 assert g.reset_time == want.reset_time, ctx
         frozen_clock.advance(rng.choice([0, 100, 2_000]))
+
+
+def test_global_psum_vs_broadcast_reconvergence(frozen_clock):
+    """The one-psum sync collective reconverges EXACTLY like the
+    broadcast-plane reference form (the all_to_all + sort/segment step
+    that models the RPC sendHits/UpdatePeerGlobals loops): the same
+    GLOBAL traffic with interleaved syncs through two engines — psum vs
+    a2a — must produce identical responses at every step, identical
+    synced-key counts, and identical post-reconvergence auth rows and
+    zero-hit reads for every key."""
+    from gubernator_tpu.parallel.global_sync import GlobalEngine
+    from gubernator_tpu.parallel.sharded import MeshBackend
+
+    rng = random.Random(5)
+    e_psum = GlobalEngine(
+        MeshBackend(MESH_DEV, clock=frozen_clock), collective="psum"
+    )
+    e_a2a = GlobalEngine(
+        MeshBackend(MESH_DEV, clock=frozen_clock), collective="a2a"
+    )
+    keys = [f"g{i}" for i in range(24)]
+    for step in range(8):
+        batch = [
+            RateLimitReq(
+                name="gx", unique_key=rng.choice(keys),
+                hits=rng.choice([1, 1, 2, 3]), limit=50,
+                duration=60_000, behavior=Behavior.GLOBAL,
+                algorithm=rng.choice(
+                    [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                ),
+            )
+            for _ in range(rng.randrange(4, 20))
+        ]
+        r1, r2 = e_psum.check(batch), e_a2a.check(batch)
+        assert [(r.status, r.remaining, r.reset_time) for r in r1] == \
+               [(r.status, r.remaining, r.reset_time) for r in r2], step
+        if rng.random() < 0.6:
+            assert e_psum.sync() == e_a2a.sync()
+        frozen_clock.advance(rng.choice([0, 100, 1_000]))
+    assert e_psum.sync() == e_a2a.sync()
+    probes = [
+        RateLimitReq(name="gx", unique_key=k, hits=0, limit=50,
+                     duration=60_000, behavior=Behavior.GLOBAL)
+        for k in keys
+    ]
+    p1, p2 = e_psum.check(probes), e_a2a.check(probes)
+    assert [(r.status, r.remaining, r.reset_time) for r in p1] == \
+           [(r.status, r.remaining, r.reset_time) for r in p2]
+    for k in keys:
+        i1 = e_psum.b.get_cache_item(f"gx_{k}")
+        i2 = e_a2a.b.get_cache_item(f"gx_{k}")
+        assert (i1 is None) == (i2 is None), k
+        if i1 is not None:
+            assert (i1.remaining, int(i1.status), i1.expire_at,
+                    i1.limit) == \
+                   (i2.remaining, int(i2.status), i2.expire_at,
+                    i2.limit), k
 
 
 def test_go_trunc_differential():

@@ -99,8 +99,12 @@ def test_spread_keys_match_oracle_totals(frozen_clock):
 
 def test_merge_across_sources(frozen_clock):
     """Same key hit on two source devices merges (segment-sum) before the
-    owner applies it — the all_to_all + dedup path."""
-    back, eng = _engine(frozen_clock)
+    owner applies it — the all_to_all + dedup path.  This device-side
+    merge exists only in the a2a reference collective: the psum default
+    requires the host chunk builder's globally-unique (owner, lane)
+    slots (each key on exactly ONE source grid), where the sum IS the
+    merge — so this test pins the a2a engine explicitly."""
+    back, eng = _engine(frozen_clock, collective="a2a")
     n, D = 8, 16
     key = "g_merge"
     h64 = key_hash64(key)

@@ -1,0 +1,364 @@
+"""Mesh-native ring serving (parallel/sharded.make_mesh_ring_step +
+runtime/ring.py over an 8-virtual-device mesh).
+
+The tentpole acceptance suite for PR 9: the shard_map ring step applies
+stacked grid rounds bit-identically to the mesh's classic round-at-a-
+time dispatch, the per-shard sequence words stay monotone and agree
+with the host mirror on every shard, a broken mesh ring falls back to
+the pipelined discipline per merge, and the compiled fast lane in ring
+mode serves a mixed token/leaky/GLOBAL/store workload on the mesh with
+ZERO blocking device->host fetches on the request path — bit-identical
+to mesh-classic AND to a single-device service on the same traffic.
+CI drives the 10k-check version in scripts/mesh_smoke.py.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.config import Config, DeviceConfig
+from gubernator_tpu.core.types import Algorithm, RateLimitReq
+from gubernator_tpu.parallel.sharded import (
+    MeshBackend,
+    pack_requests_sharded,
+)
+from gubernator_tpu.runtime.ring import RingBackend, RingClosedError
+
+N = 8
+MESH_DEV = DeviceConfig(
+    num_slots=N * 8 * 64, ways=8, batch_size=64, num_shards=N
+)
+RESP_COLS = (
+    "status", "limit", "remaining", "reset_time", "stored",
+    "stored_status", "found",
+)
+
+
+def _reqs(step: int, n: int = 24):
+    return [
+        RateLimitReq(
+            name="mring",
+            unique_key=f"k{(step * 5 + i) % 13}",
+            hits=1 + (i % 2),
+            limit=40,
+            duration=60_000,
+            algorithm=(
+                Algorithm.LEAKY_BUCKET if i % 3 == 0
+                else Algorithm.TOKEN_BUCKET
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _grid_rounds(reqs, clock):
+    return pack_requests_sharded(reqs, MESH_DEV.batch_size, N, clock).rounds
+
+
+def test_mesh_ring_matches_classic_dispatch(frozen_clock):
+    """The shard_map scan applies stacked grid rounds exactly like the
+    mesh's classic loop: every response column bit-identical on every
+    shard, per-shard seq words monotone and mirror-consistent."""
+    classic = MeshBackend(MESH_DEV, clock=frozen_clock)
+    ringed = MeshBackend(MESH_DEV, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=4)
+    try:
+        seqs = [ring.seq]
+        for step in range(6):
+            reqs = _reqs(step)
+            want = classic.step_rounds(
+                _grid_rounds(reqs, frozen_clock), add_tally=False
+            )
+            got = ring.submit_rounds(_grid_rounds(reqs, frozen_clock))()
+            assert len(got) == len(want)
+            for wh, gh in zip(want, got):
+                for col in RESP_COLS:
+                    w = wh[col]
+                    np.testing.assert_array_equal(
+                        w, gh[col][..., : w.shape[-1]], err_msg=col
+                    )
+            seqs.append(ring.seq)
+            # Every shard's device word marched with the host mirror.
+            assert ring.seq_shards == [ring.seq] * N
+            frozen_clock.advance(250)
+    finally:
+        ring.close()
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert ring.seq_mismatches == 0
+    assert ring.rounds_consumed >= 6
+
+
+def test_mesh_ring_coalesces_mixed_tiers(frozen_clock):
+    """Grid merges packed at different batch tiers coalesce into one
+    mesh ring block and come back at their own tiers (the
+    runtime/ring.py layout-agnostic padding, grid edition)."""
+    import threading
+
+    tiered = DeviceConfig(
+        num_slots=N * 8 * 64, ways=8, batch_size=64, num_shards=N,
+        batch_tiers=(8, 64),
+    )
+    classic = MeshBackend(tiered, clock=frozen_clock)
+    ringed = MeshBackend(tiered, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=4)
+    gate = threading.Event()
+
+    def uniq(tag, n):
+        return [
+            RateLimitReq(name="mring", unique_key=f"{tag}{i}", hits=1,
+                         limit=40, duration=60_000)
+            for i in range(n)
+        ]
+
+    try:
+        ring.submit_host(gate.wait)  # stall so both merges coalesce
+        small = pack_requests_sharded(
+            uniq("s", 3), 64, N, frozen_clock
+        ).rounds
+        big = pack_requests_sharded(
+            uniq("b", 48), 64, N, frozen_clock
+        ).rounds
+        w_small = ring.submit_rounds(small)
+        w_big = ring.submit_rounds(big)
+        gate.set()
+        got_small, got_big = w_small(), w_big()
+    finally:
+        gate.set()
+        ring.close()
+    assert ring.iterations == 1 and ring.max_block == 2
+    assert got_small[0]["status"].shape == (N, 8)
+    assert got_big[0]["status"].shape == (N, 64)
+    for reqs, got in ((uniq("s", 3), got_small), (uniq("b", 48), got_big)):
+        want = classic.step_rounds(
+            pack_requests_sharded(reqs, 64, N, frozen_clock).rounds,
+            add_tally=False,
+        )
+        for wh, gh in zip(want, got):
+            for col in RESP_COLS:
+                w = wh[col]
+                np.testing.assert_array_equal(
+                    w, gh[col][..., : w.shape[-1]], err_msg=col
+                )
+    assert ring.seq_mismatches == 0
+
+
+def test_mesh_ring_broken_fallback(frozen_clock):
+    """A broken mesh ring fails queued blocks and later merges take the
+    pipelined path (available() False) — the per-merge fallback rule,
+    unchanged on the mesh."""
+    be = MeshBackend(MESH_DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=4)
+    try:
+        ring.submit_rounds(_grid_rounds(_reqs(0), frozen_clock))()
+        ring._mark_broken()
+        assert not ring.available()
+        with pytest.raises(RingClosedError):
+            ring.submit_rounds(_grid_rounds(_reqs(1), frozen_clock))
+        # The backend itself still serves (the fast lane's fallback
+        # target): classic dispatch is unaffected by the dead ring.
+        host = be.step_rounds(
+            _grid_rounds(_reqs(2), frozen_clock), add_tally=False
+        )
+        assert len(host) >= 1
+    finally:
+        ring.close()
+
+
+def test_mesh_shard_occupancy(frozen_clock):
+    """Per-shard occupancy sums to the aggregate and reflects routed
+    inserts (the skew view /debug/vars + gubernator_shard_occupancy
+    export)."""
+    be = MeshBackend(MESH_DEV, clock=frozen_clock)
+    be.check(_reqs(0, n=40))
+    per = be.shard_occupancy()
+    assert len(per) == N
+    assert sum(per) == be.occupancy() > 0
+
+
+def test_mesh_ways_env_knob(monkeypatch):
+    """GUBER_MESH_WAYS drives the mesh axis size (overriding the
+    GUBER_TPU_NUM_SHARDS alias) and invalid geometries are rejected AT
+    STARTUP with the env surface named — not deep inside MeshBackend
+    construction."""
+    from gubernator_tpu.core.config import (
+        mesh_ways_from_env,
+        setup_daemon_config,
+    )
+
+    assert mesh_ways_from_env() == 0  # unset defers to the alias
+    monkeypatch.setenv("GUBER_TPU_NUM_SLOTS", str(N * 8 * 64))
+    monkeypatch.setenv("GUBER_TPU_NUM_SHARDS", "2")
+    monkeypatch.setenv("GUBER_MESH_WAYS", "8")
+    conf = setup_daemon_config()
+    assert conf.device.num_shards == 8  # MESH_WAYS wins over the alias
+    monkeypatch.setenv("GUBER_MESH_WAYS", "0")
+    with pytest.raises(ValueError, match="GUBER_MESH_WAYS"):
+        setup_daemon_config()
+    # Slots not divisible by ways*mesh_ways: startup rejection that
+    # names the geometry env surface.
+    monkeypatch.setenv("GUBER_MESH_WAYS", "7")
+    with pytest.raises(ValueError, match="GUBER_MESH_WAYS"):
+        setup_daemon_config()
+    monkeypatch.delenv("GUBER_MESH_WAYS")
+    monkeypatch.setenv("GUBER_TPU_NUM_SHARDS", "0")
+    with pytest.raises(ValueError, match="GUBER_TPU_NUM_SHARDS"):
+        setup_daemon_config()
+
+
+def _mixed_payloads(n_workers: int, per_worker: int, seed: int = 29):
+    """Deterministic mixed schedules: exact token/leaky churn (k0..k5),
+    GLOBAL constant-param keys (k6..k9, at most ONE occurrence per
+    payload — the mesh engine aggregates intra-batch duplicates by
+    design, so duplicate GLOBAL lanes would legitimately diverge from a
+    single-device serve), disjoint key spaces per worker."""
+    from gubernator_tpu.core.types import Behavior
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    rng = random.Random(seed)
+    schedules = []
+    for w in range(n_workers):
+        payloads = []
+        for _ in range(per_worker):
+            reqs = []
+            glob_used = set()
+            for _ in range(rng.randrange(2, 14)):
+                if rng.random() < 0.30 and len(glob_used) < 4:
+                    k = 6 + rng.randrange(4)
+                    if k in glob_used:
+                        continue
+                    glob_used.add(k)
+                    reqs.append(pb.RateLimitReq(
+                        name=f"mr{w}",
+                        unique_key=f"k{k}",
+                        hits=rng.choice([0, 1, 1, 2]),
+                        limit=20 + 10 * (k % 2),
+                        duration=60_000,
+                        algorithm=k % 2,
+                        behavior=int(Behavior.GLOBAL),
+                        burst=25 if k % 3 == 0 else 0,
+                    ))
+                    continue
+                behavior = 0
+                duration = rng.choice([60_000, 60_000, 1_000])
+                if rng.random() < 0.10:
+                    behavior |= int(Behavior.RESET_REMAINING)
+                if rng.random() < 0.08:
+                    behavior |= int(Behavior.DURATION_IS_GREGORIAN)
+                    duration = rng.choice([1, 4])
+                reqs.append(pb.RateLimitReq(
+                    name=f"mr{w}",
+                    unique_key=f"k{rng.randrange(6)}",
+                    hits=rng.choice([0, 1, 1, 2, 3, -1]),
+                    limit=rng.choice([20, 30]),
+                    duration=duration,
+                    algorithm=rng.choice([0, 1]),
+                    behavior=behavior,
+                    burst=rng.choice([0, 0, 25]),
+                ))
+            payloads.append(
+                pb.GetRateLimitsReq(requests=reqs).SerializeToString()
+            )
+        schedules.append(payloads)
+    return schedules
+
+
+def test_mesh_ring_mode_differential(frozen_clock):
+    """PR 9 acceptance: the same mixed token/leaky/GLOBAL/store traffic
+    through (a) a mesh service in ring mode, (b) the same mesh in
+    classic mode, and (c) a single-device classic service produces
+    IDENTICAL responses; mesh-ring matches mesh-classic on final table
+    rows too; and the mesh-ring run performs zero blocking request-path
+    fetches beyond the documented store-mode leaky-capture residual,
+    with zero per-shard sequence mismatches."""
+    import asyncio
+
+    from gubernator_tpu import native
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.runtime.fastpath import FastPath
+    from gubernator_tpu.runtime.service import Service
+    from gubernator_tpu.runtime.store import MockStore
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    from gubernator_tpu.core.config import BehaviorConfig
+
+    n_workers, per_worker = 4, 10
+    schedules = _mixed_payloads(n_workers, per_worker)
+    single_dev = DeviceConfig(num_slots=4096, ways=8, batch_size=64)
+    # Quiesce the collective sync cadence: after a sync the mesh engine
+    # serves GLOBAL reads from the broadcast row VERBATIM (stale-but-
+    # fast, gubernator.go:434-447) while a single-device owner read is
+    # exact — a mid-run sync would make the cross-topology comparison
+    # diverge BY CONTRACT, not by bug.  Sync-equivalence itself is
+    # pinned by test_global_psum_vs_broadcast_reconvergence.
+    quiet = BehaviorConfig(global_sync_wait_s=3600.0)
+
+    def run(dev_cfg, mode: str):
+        async def scenario():
+            store = MockStore()
+            svc = Service(
+                Config(device=dev_cfg, store=store, behaviors=quiet),
+                clock=frozen_clock,
+            )
+            await svc.start()
+            fp = FastPath(svc, serve_mode=mode, ring_slots=4)
+            results: dict = {}
+
+            async def worker(w: int):
+                await asyncio.sleep(w * 0.003)
+                got = []
+                for payload in schedules[w]:
+                    raw = await fp.check_raw(payload, peer_rpc=False)
+                    assert raw is not None
+                    got.append([
+                        (r.status, r.limit, r.remaining, r.reset_time,
+                         r.error)
+                        for r in pb.GetRateLimitsResp.FromString(
+                            raw
+                        ).responses
+                    ])
+                results[w] = got
+
+            await asyncio.gather(*(worker(w) for w in range(n_workers)))
+            rows = {}
+            for w in range(n_workers):
+                for k in range(10):
+                    key = f"mr{w}_k{k}"
+                    item = svc.backend.get_cache_item(key)
+                    rows[key] = (
+                        (item.remaining, item.expire_at,
+                         int(item.status), item.limit, item.duration)
+                        if item is not None else None
+                    )
+            dv = fp.debug_vars()
+            await fp.close()
+            await svc.close()
+            return results, rows, dv
+
+        return asyncio.run(scenario())
+
+    mesh_classic, mc_rows, mc_dv = run(MESH_DEV, "classic")
+    mesh_ring, mr_rows, mr_dv = run(MESH_DEV, "ring")
+    single, _s_rows, _s_dv = run(single_dev, "classic")
+
+    # Mesh-ring ≡ mesh-classic: responses AND final table rows.
+    assert mesh_ring == mesh_classic
+    assert mr_rows == mc_rows
+    # ≡ single-device responses (rows live in different tables — the
+    # engine's replicated cache serves GLOBAL on the mesh — so the
+    # cross-topology comparison is on what clients observe).
+    assert mesh_ring == single
+
+    # The ring actually served and the fetch discipline held: zero
+    # blocking request-path fetches except the documented store-mode
+    # leaky-capture rf readback (machinery lane only).
+    assert mr_dv["effective_serve_mode"] == "ring"
+    assert mr_dv["ring"]["iterations"] + mr_dv["ring"]["host_jobs"] > 0
+    assert mr_dv["ring"]["seq_mismatches"] == 0
+    assert mr_dv["blocking_fetches"]["engine"] == 0
+    assert mr_dv["blocking_fetches"]["sketch"] == 0
+    # The classic run paid request-path fetches — the counter is live.
+    assert mc_dv["blocking_fetches"]["mach"] > 0

@@ -375,23 +375,43 @@ def test_ring_env_knobs(monkeypatch):
         setup_daemon_config()
 
 
-def test_mesh_backend_has_no_ring():
-    from gubernator_tpu.parallel.sharded import MeshBackend
+def test_mesh_backend_supports_ring(frozen_clock):
+    """The mesh is ring-native (PR 9): MeshBackend reports ring support,
+    arms a RingBackend, and a submitted grid round publishes through the
+    shard_map ring step with consistent per-shard sequence words.
+    (Deeper coverage: tests/test_mesh_ring.py.)"""
+    from gubernator_tpu.parallel.sharded import (
+        MeshBackend,
+        pack_requests_sharded,
+    )
 
     assert DeviceBackend(DEV).ring_supported()
     mesh_cfg = DeviceConfig(
         num_slots=8 * 8 * 64, ways=8, batch_size=64, num_shards=8
     )
-    assert not MeshBackend(mesh_cfg).ring_supported()
-    with pytest.raises(ValueError, match="does not support"):
-        RingBackend(MeshBackend(mesh_cfg))
+    be = MeshBackend(mesh_cfg, clock=frozen_clock)
+    assert be.ring_supported()
+    assert be.ring_q_shape(16) == (12, 8, 16)
+    ring = RingBackend(be, slots=2)
+    try:
+        rounds = pack_requests_sharded(
+            _reqs(0), mesh_cfg.batch_size, 8, frozen_clock
+        ).rounds
+        got = ring.submit_rounds(rounds)()
+        assert len(got) == len(rounds)
+        assert got[0]["status"].shape[0] == 8  # grid responses
+        assert ring.seq_mismatches == 0
+        assert ring.seq_shards == [ring.seq] * 8
+    finally:
+        ring.close()
 
 
 def test_fastpath_ring_fallback_modes(frozen_clock):
     """serve_mode plumbing on FastPath: classic forces depth 1; ring on
-    a mesh service degrades to pipelined (the docs/ring.md fallback
-    rule); ring on a single-table service arms a RingBackend; a BROKEN
-    ring drops merges back to the pipelined path per merge."""
+    a single-table service arms a RingBackend; a BROKEN ring drops
+    merges back to the pipelined path per merge; ring on a MESH service
+    arms a real mesh ring (the old silent mesh fallback is retired —
+    docs/ring.md); a backend without ring support still degrades."""
     import asyncio
 
     from gubernator_tpu.runtime.fastpath import FastPath
@@ -410,6 +430,15 @@ def test_fastpath_ring_fallback_modes(frozen_clock):
         fp._ring.broken = True  # simulate a device fault
         assert fp._ring_live() is None  # merges take the pipelined path
         await fp.close()
+
+        # A backend WITHOUT ring support (not the mesh anymore) still
+        # takes the documented construction-time fallback.
+        svc.backend.ring_supported = lambda: False
+        fp = FastPath(svc, serve_mode="ring")
+        assert fp.serve_mode == "ring"
+        assert fp.effective_serve_mode == "pipelined"
+        assert fp._ring is None
+        await fp.close()
         await svc.close()
 
         mesh_cfg = DeviceConfig(
@@ -419,8 +448,8 @@ def test_fastpath_ring_fallback_modes(frozen_clock):
         await svc.start()
         fp = FastPath(svc, serve_mode="ring")
         assert fp.serve_mode == "ring"
-        assert fp.effective_serve_mode == "pipelined"
-        assert fp._ring is None
+        assert fp.effective_serve_mode == "ring"
+        assert fp._ring is not None
         await fp.close()
         await svc.close()
 

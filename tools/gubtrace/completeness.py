@@ -18,12 +18,18 @@ from typing import Iterable, List, Set
 
 from tools.gubtrace.core import _PRAGMA_RE, Checker, Finding, RunContext
 
-# Modules whose module-level jits the registry must cover.
+# Modules whose module-level jits the registry must cover.  The mesh
+# entrypoints (parallel/sharded.py, parallel/global_sync.py) are
+# factory-built shard_map kernels — no module-level jits today — but
+# watching them means a future `X = jax.jit(...)` there is flagged
+# instead of silently shipping unverified.
 WATCHED_MODULES = (
     "gubernator_tpu/ops/step.py",
     "gubernator_tpu/ops/sketch.py",
     "gubernator_tpu/ops/pallas/cms_kernel.py",
     "gubernator_tpu/ops/ring.py",
+    "gubernator_tpu/parallel/sharded.py",
+    "gubernator_tpu/parallel/global_sync.py",
 )
 
 

@@ -374,13 +374,17 @@ def _delta_grid():
     return type(grid)(*[_sharded(a, ("shard",)) for a in grid])
 
 
-def _global_sync_spec() -> KernelSpec:
+def _global_sync_spec(psum: bool = False) -> KernelSpec:
     def build() -> BuiltKernel:
         from gubernator_tpu.parallel.global_sync import (
             make_global_sync_step,
+            make_global_sync_step_psum,
         )
 
-        fn = make_global_sync_step(_mesh(), WAYS)
+        factory = make_global_sync_step_psum if psum else (
+            make_global_sync_step
+        )
+        fn = factory(_mesh(), WAYS)
 
         def sig():
             return (_mesh_table(), _mesh_table(), _delta_grid(), _now())
@@ -394,7 +398,10 @@ def _global_sync_spec() -> KernelSpec:
             ),
             # Two apply_batch passes ride inside the sync step; the
             # broadcast re-read runs with hits=0 (a literal, untainted)
-            # so its _f64(r_hits) does not count: 11 + 10.
+            # so its _f64(r_hits) does not count: 11 + 10.  The psum
+            # form shares the budget — it swaps the aggregation
+            # collective (one psum vs all_to_all + sort/segment), not
+            # the apply passes.
             allowed_casts={"to_f64": 21},
             perturbations={},
             recompile_budget=1,
@@ -402,8 +409,56 @@ def _global_sync_spec() -> KernelSpec:
         )
 
     return KernelSpec(
-        name="global_sync_step",
+        name="global_sync_step_psum" if psum else "global_sync_step",
         where="gubernator_tpu/parallel/global_sync.py",
+        build=build,
+    )
+
+
+def _mesh_ring_spec() -> KernelSpec:
+    """parallel/sharded.py make_mesh_ring_step: the ring discipline's
+    bounded scan lifted to the sharded grid table (docs/ring.md).  Each
+    shard runs ops/ring.ring_step_impl verbatim, so the taint and cast
+    contract is exactly ring_step's (11 to_f64 leaky float sites + 1
+    to_i32 algo narrowing propagated through the shard_map + scan
+    carry); the per-shard sequence words are tainted int64 arithmetic
+    with no cast.  Only the table is donated — the seq words' output
+    buffers must survive the next iteration's dispatch (the
+    double-buffered response protocol), exactly the single-device keep
+    rule."""
+
+    def build() -> BuiltKernel:
+        from gubernator_tpu.parallel.sharded import make_mesh_ring_step
+
+        fn = make_mesh_ring_step(_mesh(), WAYS)
+
+        def sig(k: int):
+            return lambda: (
+                _mesh_table(),
+                _sharded(
+                    np.zeros((k, 12, N_SHARDS, MESH_B), np.int64),
+                    (None, None, "shard"),
+                ),
+                np.zeros(k, np.int64),
+                _sharded(np.zeros(N_SHARDS, np.int64), ("shard",)),
+            )
+
+        return BuiltKernel(
+            fn=fn,
+            trace_fn=fn,
+            signatures={"k1": sig(1), "k2": sig(2)},
+            counters=_TABLE_COUNTERS + ("[1]", "[2]", "[3]"),
+            allowed_casts=dict(_APPLY_Q_CASTS),
+            perturbations={},
+            # Two slot tiers, mesh callers always normalize `now`
+            # (np.int64 in ring_step_dispatch) — no weak variant.
+            recompile_budget=2,
+            expect_aliased=12,  # table only — per-shard seq kept
+        )
+
+    return KernelSpec(
+        name="mesh_ring_step",
+        where="gubernator_tpu/parallel/sharded.py",
         build=build,
     )
 
@@ -550,7 +605,9 @@ def specs() -> List[KernelSpec]:
             lambda: (_hash_grid(),),
             _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=0,
         ),
+        _mesh_ring_spec(),
         _global_sync_spec(),
+        _global_sync_spec(psum=True),
         # -- runtime/sketch_backend.py: the merge-scan step -------------
         _sketch_multi_spec(),
     ]
