@@ -107,6 +107,7 @@ def bench(seconds: float, concurrency: int,
           serve_sweep: Tuple[str, ...] = ("classic", "pipelined", "ring"),
           workload: str = "",
           mesh_shards: int = 0,
+          client_modes: Tuple[str, ...] = ("python", "native", "leased"),
           ) -> None:
     """Sync driver: client coroutines run on each cluster's OWN loop —
     grpc.aio multiplexes one poller per process, and a second event loop
@@ -552,6 +553,114 @@ def bench(seconds: float, concurrency: int,
                 "error": str(e),
             }))
 
+    # ---- client-mode sweep: python vs native vs leased -----------------
+    # The CLIENT half of the E2E budget (ISSUE 10): the same steady
+    # single-key load driven through each SDK tier, measuring what the
+    # caller pays per check INCLUDING its own client machinery (the
+    # other configs deliberately pre-serialize payloads to exclude it):
+    #   python  V1Client — python-protobuf build/parse per call (the
+    #           measured ~1.3ms of grpc.aio/protobuf machinery);
+    #   native  FastV1Client — the compiled codec (gub_serialize_reqs /
+    #           gub_parse_resps2) over a raw-bytes channel;
+    #   leased  LeasedClient — client-side admission: checks burn a
+    #           granted local allowance with ZERO RPCs (docs/leases.md).
+    # The acceptance column is rpcs_per_admitted_check: leased must be
+    # >= 10x below python under steady single-key load.
+    if client_modes:
+        try:
+            from gubernator_tpu.client import (
+                FastV1Client,
+                LeasedClient,
+                V1Client,
+            )
+            from gubernator_tpu.core.config import LeaseConfig
+            from gubernator_tpu.core.types import RateLimitReq, Status
+
+            c = Cluster.start_with(
+                [""], device=dev_cfg, conf_template=conf()
+            )
+            try:
+                addr = c.daemons[0].grpc_address
+                sweep_seconds = max(2.0, seconds / 2)
+                lease_cfg = LeaseConfig(
+                    fraction=0.25, ttl_ms=60_000, max_holders=4,
+                    reconcile_ms=500, low_water=0.25,
+                )
+                req = RateLimitReq(
+                    name="bench_client", unique_key="steady", hits=1,
+                    limit=1_000_000_000, duration=3_600_000,
+                )
+                mode_budget = {"config": "client_mode_budget"}
+                for mode in client_modes:
+                    if mode == "python":
+                        cl = V1Client(addr)
+                    elif mode == "native":
+                        cl = FastV1Client(addr)
+                    elif mode == "leased":
+                        cl = LeasedClient(addr, lease=lease_cfg)
+                    else:
+                        raise ValueError(
+                            f"unknown client mode {mode!r}; expected "
+                            "python, native, leased"
+                        )
+                    try:
+                        for _ in range(50):  # warm (+ lease grant)
+                            cl.get_rate_limits([req])
+                        warm_rpcs = (
+                            cl.stats()["rpcs"] if mode == "leased"
+                            else 50
+                        )
+                        lat = []
+                        admitted = calls = 0
+                        t0 = time.perf_counter()
+                        t_end = t0 + sweep_seconds
+                        while time.perf_counter() < t_end:
+                            s0 = time.perf_counter()
+                            r = cl.get_rate_limits([req])[0]
+                            lat.append(time.perf_counter() - s0)
+                            calls += 1
+                            if (
+                                r.error == ""
+                                and r.status == Status.UNDER_LIMIT
+                            ):
+                                admitted += 1
+                        wall = time.perf_counter() - t0
+                        if mode == "leased":
+                            st = cl.stats()
+                            rpcs = st["rpcs"] - warm_rpcs
+                            extra_stats = {"client_stats": st}
+                        else:
+                            rpcs = calls
+                            extra_stats = {}
+                        rpac = round(rpcs / max(admitted, 1), 6)
+                        mode_budget[
+                            f"rpcs_per_admitted_check_{mode}"
+                        ] = rpac
+                        emit(
+                            f"client_sweep_{mode}", calls, rpcs, lat,
+                            wall, {
+                                "client_mode": mode,
+                                "concurrency": 1,
+                                "admitted": admitted,
+                                "rpcs_per_admitted_check": rpac,
+                                **(
+                                    {"codec": cl.codec}
+                                    if mode == "native" else {}
+                                ),
+                                **extra_stats,
+                            },
+                        )
+                    finally:
+                        cl.close()
+                results.append(mode_budget)
+                print(json.dumps(mode_budget), flush=True)
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate sweep failures
+            print(json.dumps({
+                "config": "client_sweep", "error": str(e),
+            }))
+
     # ---- mesh serve-mode sweep: the deployment-mode benchmark ----------
     # Re-run the throughput + small-batch configs per drain discipline
     # on a MESH daemon (--mesh-shards; the production shape: one daemon
@@ -926,6 +1035,7 @@ def bench(seconds: float, concurrency: int,
         "serve_mode": serve_mode,
         "ring_slots": ring_slots,
         "serve_mode_sweep": list(serve_sweep),
+        "client_mode_sweep": list(client_modes),
         "mesh_shards": mesh_shards,
         "device": {
             "num_slots": dev_cfg.num_slots,
@@ -952,6 +1062,14 @@ def main() -> None:
         "throughput + small-batch configs per drain discipline "
         "(empty disables); the ring entry reports the fetch-free "
         "budget split (docs/ring.md)",
+    )
+    ap.add_argument(
+        "--client-mode", default="python,native,leased",
+        help="comma-separated client-SDK sweep over a steady single-key "
+        "load, measuring each tier's own machinery (V1Client python "
+        "protobuf vs FastV1Client compiled codec vs LeasedClient "
+        "zero-RPC local burns) with an rpcs_per_admitted_check column "
+        "(docs/leases.md; empty disables)",
     )
     ap.add_argument(
         "--workload", default="",
@@ -983,9 +1101,12 @@ def main() -> None:
     modes = tuple(
         m.strip() for m in args.serve_mode.split(",") if m.strip()
     )
+    cmodes = tuple(
+        m.strip() for m in args.client_mode.split(",") if m.strip()
+    )
     bench(args.seconds, args.concurrency, depth_sweep=sweep,
           serve_sweep=modes, workload=args.workload,
-          mesh_shards=args.mesh_shards)
+          mesh_shards=args.mesh_shards, client_modes=cmodes)
 
 
 if __name__ == "__main__":

@@ -227,6 +227,95 @@ def hotkey_config_from_env() -> HotKeyConfig:
         raise ValueError(f"hot-key env config: {e}") from None
 
 
+@dataclass
+class LeaseConfig:
+    """Client-side admission leases (runtime/lease.py; docs/leases.md;
+    no reference analog — the cheapest RPC is the one never sent,
+    arXiv:2510.04516).
+
+    A key's owner grants a holder (a LeasedClient or an edge daemon) a
+    bounded LOCAL allowance of `fraction x limit` hits it may burn with
+    zero RPCs, valid for `ttl_ms`.  Allowances are carved from a
+    `<unique_key>.lease-grant` shadow slot sized
+    `max_holders x fraction x limit` per window — the hot-mirror
+    algebra — so cluster-wide admission for a leased key is bounded by
+    `limit x (1 + max_holders x fraction)` even if every holder
+    partitions away with a full grant.  Burned hits reconcile
+    asynchronously (at-most-once); grants are refused while the owner
+    is shedding under SLO pressure.  `low_water` and `reconcile_ms`
+    are CLIENT cadence knobs (grant refresh threshold, reconcile
+    interval) parsed here so the SDK and the daemon read one surface.
+    """
+
+    enabled: bool = True
+    # Fraction of the limit one holder's allowance covers.
+    fraction: float = 0.25
+    # Grant lifetime in milliseconds; an expired grant burns nothing.
+    ttl_ms: int = 2000
+    # Concurrent holders per key; the over-admission bound multiplier.
+    max_holders: int = 4
+    # Client-side: refresh the grant in the background once remaining
+    # allowance drops below low_water x allowance.
+    low_water: float = 0.25
+    # Client-side: burned-hit reconcile cadence in milliseconds.  Must
+    # not exceed ttl_ms (a grant would expire between reconciles and
+    # the owner would re-collect allowances still in active use).
+    reconcile_ms: int = 500
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.fraction <= 1.0:
+            raise ValueError(
+                f"lease fraction must be in (0, 1], got {self.fraction}"
+            )
+        if self.ttl_ms < 1:
+            raise ValueError(
+                f"lease ttl_ms must be >= 1, got {self.ttl_ms}"
+            )
+        if self.max_holders < 1:
+            raise ValueError(
+                f"lease max_holders must be >= 1, got {self.max_holders}"
+            )
+        if not 0.0 <= self.low_water < 1.0:
+            raise ValueError(
+                f"lease low_water must be in [0, 1), got {self.low_water}"
+            )
+        if self.reconcile_ms < 1:
+            raise ValueError(
+                f"lease reconcile_ms must be >= 1, got {self.reconcile_ms}"
+            )
+        if self.ttl_ms < self.reconcile_ms:
+            raise ValueError(
+                "lease ttl_ms must be >= reconcile_ms (a grant must "
+                f"outlive the reconcile cadence), got ttl_ms="
+                f"{self.ttl_ms} < reconcile_ms={self.reconcile_ms}"
+            )
+
+
+def lease_config_from_env() -> LeaseConfig:
+    """The lease plane's env parse, shared by the daemon and the client
+    SDK (same contract as hotkey_config_from_env): validation errors
+    name the env surface at startup instead of crashing a constructor
+    later."""
+    try:
+        return LeaseConfig(
+            enabled=_env("GUBER_LEASE_ENABLED", "true").lower()
+            not in ("0", "false", "no"),
+            fraction=float(_env("GUBER_LEASE_FRACTION", "0.25")),
+            ttl_ms=int(_env_float_s("GUBER_LEASE_TTL", 2.0) * 1000),
+            max_holders=_env_int("GUBER_LEASE_MAX_HOLDERS", 4),
+            low_water=float(_env("GUBER_LEASE_LOW_WATER", "0.25")),
+            reconcile_ms=int(
+                _env_float_s("GUBER_LEASE_RECONCILE", 0.5) * 1000
+            ),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "lease env config (GUBER_LEASE_FRACTION, GUBER_LEASE_TTL, "
+            "GUBER_LEASE_MAX_HOLDERS, GUBER_LEASE_LOW_WATER, "
+            f"GUBER_LEASE_RECONCILE): {e}"
+        ) from None
+
+
 # Fast-lane drain disciplines (runtime/fastpath.py; docs/ring.md):
 #   classic    — strict depth-1: every merge's dispatch AND fetch
 #                serialize end to end (the pre-PR5 discipline);
@@ -366,6 +455,8 @@ class Config:
     shadow_fraction: float = 0.5
     # Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md).
     hotkey: HotKeyConfig = field(default_factory=HotKeyConfig)
+    # Client-side admission leases (runtime/lease.py; docs/leases.md).
+    lease: LeaseConfig = field(default_factory=LeaseConfig)
 
 
 @dataclass
@@ -465,6 +556,9 @@ class DaemonConfig:
     # Hot-key survival plane (runtime/hotkey.py; docs/hotkeys.md):
     # owner-pressure detection, bounded mirroring, SLO-driven shedding.
     hotkey: HotKeyConfig = field(default_factory=HotKeyConfig)
+    # Client-side admission leases (runtime/lease.py; docs/leases.md):
+    # bounded local allowances on the peers wire.
+    lease: LeaseConfig = field(default_factory=LeaseConfig)
     # Chaos plane (testing/chaos.py): a seeded fault plan injected at
     # the peer-client and daemon RPC boundaries.  `chaos_plan` is a JSON
     # plan file (empty = no chaos — the production default); `chaos`
@@ -794,6 +888,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         ),
         shadow_fraction=shadow_fraction,
         hotkey=hotkey_config_from_env(),
+        lease=lease_config_from_env(),
         chaos_plan=_env("GUBER_CHAOS_PLAN", ""),
         chaos_seed=_env_int("GUBER_CHAOS_SEED", 0),
     )

@@ -111,6 +111,42 @@ class UpdatePeerGlobal:
     algorithm: Algorithm = Algorithm.TOKEN_BUCKET
 
 
+@dataclass
+class LeaseGrant:
+    """One granted (or refused) client-side admission lease
+    (peers.proto Lease/Reconcile; docs/leases.md).
+
+    `allowance` hits may be burned locally with zero RPCs until
+    `expires_at` (unix ms); a non-empty `refusal` means no allowance was
+    granted (allowance == 0) and the holder must degrade to per-call
+    checks.  `reset_time` is the carve slot's window reset — the
+    holder's local remaining/reset view between reconciles."""
+
+    key: str = ""  # hash key (name + "_" + unique_key)
+    allowance: int = 0
+    expires_at: int = 0  # unix ms
+    reset_time: int = 0  # unix ms
+    limit: int = 0
+    refusal: str = ""  # empty = granted
+
+    @property
+    def granted(self) -> bool:
+        return self.allowance > 0 and not self.refusal
+
+
+@dataclass
+class ReconcileItem:
+    """One holder->owner reconcile entry: `request.hits` carries the
+    hits burned locally since the last reconcile (0 = nothing new);
+    `release` drops the holder's grant outright; `renew` piggybacks a
+    grant refresh on the reconcile RPC (the low-water refresh without a
+    second round trip)."""
+
+    request: RateLimitReq = field(default_factory=RateLimitReq)
+    release: bool = False
+    renew: bool = False
+
+
 @dataclass(frozen=True)
 class PeerInfo:
     """Cluster-membership record (reference config.go peer info struct)."""

@@ -226,6 +226,25 @@ class _PeersServicer:
         await self.d.service.update_peer_globals(globals_)
         return peers_pb2.UpdatePeerGlobalsResp()
 
+    async def Lease(self, request, context):
+        """Client-side admission (docs/leases.md): grant bounded local
+        allowances for owned keys, proxy the rest to their owners."""
+        grants = await self.d.service.lease(
+            request.client_id, grpc_api.reqs_from_pb(request.requests)
+        )
+        return peers_pb2.LeaseResp(
+            grants=[grpc_api.lease_grant_to_pb(g) for g in grants]
+        )
+
+    async def Reconcile(self, request, context):
+        items = [
+            grpc_api.reconcile_item_from_pb(it) for it in request.items
+        ]
+        grants = await self.d.service.reconcile(request.client_id, items)
+        return peers_pb2.ReconcileResp(
+            grants=[grpc_api.lease_grant_to_pb(g) for g in grants]
+        )
+
 
 class Daemon:
     """One gubernator-tpu node."""
@@ -312,6 +331,7 @@ class Daemon:
             degraded_mode=getattr(self.conf, "degraded_mode", "error"),
             shadow_fraction=getattr(self.conf, "shadow_fraction", 0.5),
             hotkey=getattr(self.conf, "hotkey", None) or Config().hotkey,
+            lease=getattr(self.conf, "lease", None) or Config().lease,
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -690,6 +710,10 @@ class Daemon:
                         ),
                     },
                 }
+            if s.leases is not None:
+                # Client-side admission leases (docs/leases.md): grant/
+                # refusal counters, per-key holder expiries, knobs.
+                out["leases"] = s.leases.debug_vars()
         fp = self.fastpath
         if fp is not None:
             # Per-lane drain/pipeline counters (drains, overlap_drains,

@@ -163,6 +163,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,
     ]
     lib.gub_serialize_resps2.restype = ctypes.c_int64
+    lib.gub_serialize_reqs.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.gub_serialize_reqs.restype = ctypes.c_int64
     return lib
 
 
@@ -339,6 +355,44 @@ def parse_resps(payload: bytes) -> Optional[ParsedResps]:
     if got != n:
         return None
     return cols
+
+
+def encode_reqs(reqs) -> Optional[bytes]:
+    """Emit GetRateLimitsReq / GetPeerRateLimitsReq wire bytes for a
+    sequence of RateLimitReq dataclasses without constructing python
+    protobuf objects — the compiled CLIENT codec (client.FastV1Client;
+    gub_serialize_reqs).  Returns None when the native library is
+    unavailable (callers fall back to python-protobuf)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(reqs)
+    names = [r.name.encode() for r in reqs]
+    keys = [r.unique_key.encode() for r in reqs]
+    name_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in names], out=name_off[1:])
+    key_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in keys], out=key_off[1:])
+
+    def col(attr):
+        return np.fromiter(
+            (int(getattr(r, attr)) for r in reqs),
+            dtype=np.int64, count=n,
+        )
+
+    # Worst case per item: 6 numeric fields at 11 B (negative int64
+    # varints are 10 B + tag), two string frames at 6 B of framing, and
+    # the item frame header — 96 B covers it with slack.
+    cap = int(name_off[-1] + key_off[-1]) + n * 96 + 16
+    out = np.empty(cap, dtype=np.uint8)
+    written = lib.gub_serialize_reqs(
+        n, b"".join(names), name_off, b"".join(keys), key_off,
+        col("hits"), col("limit"), col("duration"), col("algorithm"),
+        col("behavior"), col("burst"), out, cap,
+    )
+    if written < 0:
+        raise RuntimeError("serialize_reqs buffer overflow")
+    return out[:written].tobytes()
 
 
 def _encode_varint(v: int) -> bytes:

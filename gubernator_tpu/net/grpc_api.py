@@ -19,8 +19,10 @@ from gubernator_tpu.core.types import (
     Algorithm,
     Behavior,
     HealthCheckResp,
+    LeaseGrant,
     RateLimitReq,
     RateLimitResp,
+    ReconcileItem,
     Status,
     UpdatePeerGlobal,
 )
@@ -112,6 +114,44 @@ def global_from_pb(m: peers_pb.UpdatePeerGlobal) -> UpdatePeerGlobal:
     )
 
 
+def lease_grant_to_pb(g: LeaseGrant) -> peers_pb.LeaseGrant:
+    return peers_pb.LeaseGrant(
+        key=g.key,
+        allowance=int(g.allowance),
+        expires_at=int(g.expires_at),
+        reset_time=int(g.reset_time),
+        limit=int(g.limit),
+        refusal=g.refusal,
+    )
+
+
+def lease_grant_from_pb(m: peers_pb.LeaseGrant) -> LeaseGrant:
+    return LeaseGrant(
+        key=m.key,
+        allowance=m.allowance,
+        expires_at=m.expires_at,
+        reset_time=m.reset_time,
+        limit=m.limit,
+        refusal=m.refusal,
+    )
+
+
+def reconcile_item_to_pb(it: ReconcileItem) -> peers_pb.ReconcileItem:
+    return peers_pb.ReconcileItem(
+        request=req_to_pb(it.request),
+        release=it.release,
+        renew=it.renew,
+    )
+
+
+def reconcile_item_from_pb(m: peers_pb.ReconcileItem) -> ReconcileItem:
+    return ReconcileItem(
+        request=req_from_pb(m.request),
+        release=m.release,
+        renew=m.renew,
+    )
+
+
 def reqs_from_pb(ms) -> List[RateLimitReq]:
     return [req_from_pb(m) for m in ms]
 
@@ -154,6 +194,16 @@ class PeersV1Stub:
             request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
             response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
         )
+        self.Lease = channel.unary_unary(
+            f"/{PEERS_SERVICE}/Lease",
+            request_serializer=peers_pb.LeaseReq.SerializeToString,
+            response_deserializer=peers_pb.LeaseResp.FromString,
+        )
+        self.Reconcile = channel.unary_unary(
+            f"/{PEERS_SERVICE}/Reconcile",
+            request_serializer=peers_pb.ReconcileReq.SerializeToString,
+            response_deserializer=peers_pb.ReconcileResp.FromString,
+        )
 
 
 # --------------------------------------------------------------------------
@@ -195,7 +245,7 @@ def peers_generic_handler(
     UpdatePeerGlobals over pb2 messages; raw=True passes GetPeerRateLimits
     payload bytes through for the compiled fast lane)."""
     rpc = grpc.unary_unary_rpc_method_handler
-    return grpc.method_handlers_generic_handler(PEERS_SERVICE, {
+    handlers = {
         "GetPeerRateLimits": rpc(
             servicer.GetPeerRateLimits,
             request_deserializer=(
@@ -211,4 +261,23 @@ def peers_generic_handler(
             request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
             response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
         ),
-    })
+    }
+    # Client-side admission leases (docs/leases.md) — low-rate control
+    # RPCs, so the python-protobuf round trip is fine here (the zero-RPC
+    # local burn is where the hot path lives).  Optional on the servicer:
+    # test doubles that only speak the forward/broadcast pair still
+    # build a handler, and callers hitting Lease on them get UNIMPLEMENTED
+    # from grpc itself.
+    if hasattr(servicer, "Lease"):
+        handlers["Lease"] = rpc(
+            servicer.Lease,
+            request_deserializer=peers_pb.LeaseReq.FromString,
+            response_serializer=peers_pb.LeaseResp.SerializeToString,
+        )
+    if hasattr(servicer, "Reconcile"):
+        handlers["Reconcile"] = rpc(
+            servicer.Reconcile,
+            request_deserializer=peers_pb.ReconcileReq.FromString,
+            response_serializer=peers_pb.ReconcileResp.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers)

@@ -26,9 +26,11 @@ import grpc.aio
 from gubernator_tpu.core.config import BehaviorConfig, CircuitConfig
 from gubernator_tpu.core.types import (
     Behavior,
+    LeaseGrant,
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
+    ReconcileItem,
     UpdatePeerGlobal,
     has_behavior,
 )
@@ -516,6 +518,108 @@ class PeerClient:
                     self._record_cancelled("UpdatePeerGlobals")
                     raise
             self._record_success()
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def lease(
+        self, client_id: str, reqs: List[RateLimitReq]
+    ) -> List[LeaseGrant]:
+        """Forward a lease-grant request to this peer (the owner of the
+        keys in `reqs`) — the edge-daemon half of client-side admission
+        (docs/leases.md).  Same shutdown/breaker/chaos accounting as the
+        broadcast path; grants come back in request order."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
+        self._track_inflight(+1)
+        try:
+            stub = await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
+            with tracing.span(
+                "peer.lease", require_parent=True,
+                peer=self.peer_info.grpc_address, method="Lease",
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address, "Lease"
+                        )
+                    req = peers_pb2.LeaseReq(
+                        client_id=client_id,
+                        requests=[grpc_api.req_to_pb(r) for r in reqs],
+                    )
+                    call = stub.Lease(
+                        req, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
+                    )
+                    resp = await call
+                    self._note_pressure_md(await call.trailing_metadata())
+                except asyncio.CancelledError:
+                    self._record_cancelled("Lease")
+                    raise
+            self._record_success()
+            return [grpc_api.lease_grant_from_pb(g) for g in resp.grants]
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def reconcile(
+        self, client_id: str, items: List[ReconcileItem]
+    ) -> List[LeaseGrant]:
+        """Forward burned-hit reconciliation (and release/renewal) for
+        leases granted by this peer.  NO PeerNotReadyError conversion:
+        like the GLOBAL flush, callers decide retry-safety via
+        provably_unsent() — a mid-RPC failure may have applied the
+        burned hits already."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
+        self._track_inflight(+1)
+        try:
+            stub = await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
+            with tracing.span(
+                "peer.reconcile", require_parent=True,
+                peer=self.peer_info.grpc_address, method="Reconcile",
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address, "Reconcile"
+                        )
+                    req = peers_pb2.ReconcileReq(
+                        client_id=client_id,
+                        items=[
+                            grpc_api.reconcile_item_to_pb(it)
+                            for it in items
+                        ],
+                    )
+                    call = stub.Reconcile(
+                        req, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
+                    )
+                    resp = await call
+                    self._note_pressure_md(await call.trailing_metadata())
+                except asyncio.CancelledError:
+                    self._record_cancelled("Reconcile")
+                    raise
+            self._record_success()
+            return [grpc_api.lease_grant_from_pb(g) for g in resp.grants]
         except grpc.aio.AioRpcError as e:
             self._record_error(str(e))
             raise

@@ -205,6 +205,34 @@ class Metrics:
             registry=r,
         )
 
+        # -- client-side admission leases (runtime/lease.py; docs/leases.md)
+        self.lease_grants = Counter(
+            "gubernator_lease_grants_total",
+            "Lease grant decisions by outcome: granted, or refused_* "
+            "(behavior / pressure / holders / exhausted / error).",
+            ["outcome"],
+            registry=r,
+        )
+        self.lease_active_grants = Gauge(
+            "gubernator_lease_active_grants",
+            "Unexpired lease holders across keys on this owner "
+            "(refreshed on grant/reconcile/sweep).",
+            registry=r,
+        )
+        self.lease_reconciled_hits = Counter(
+            "gubernator_lease_reconciled_hits_total",
+            "Holder-burned hits reconciled into authoritative rows "
+            "(at-most-once through the GLOBAL async-hit machinery).",
+            registry=r,
+        )
+        self.lease_revocations = Counter(
+            "gubernator_lease_revocations_total",
+            "Lease grants revoked, by reason (release / expiry); the "
+            "carve slot drops once a key's last holder is gone.",
+            ["reason"],
+            registry=r,
+        )
+
         # -- GLOBAL replication (global.go:48-57) -------------------------
         self.async_durations = Histogram(
             "gubernator_async_durations",

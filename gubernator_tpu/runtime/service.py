@@ -48,6 +48,7 @@ from gubernator_tpu.core.interval import GregorianError, gregorian_expiration
 from gubernator_tpu.core.types import (
     Behavior,
     HealthCheckResp,
+    LeaseGrant,
     PeerInfo,
     RateLimitReq,
     RateLimitResp,
@@ -231,6 +232,17 @@ class Service:
         self._mirror_fps_cache = None
         self.mirror_served = 0
         self.shed_served = 0
+        # Client-side admission leases (runtime/lease.py; docs/leases.md):
+        # the owner-side grant/reconcile plane for the Lease/Reconcile
+        # peer RPCs.  None when disabled — every grant then refuses.
+        self.leases = None
+        if self.cfg.lease.enabled:
+            from gubernator_tpu.runtime.lease import LeaseManager
+
+            self.leases = LeaseManager(
+                self, self.cfg.lease, metrics=self.metrics
+            )
+        self._lease_sweep_task: Optional[asyncio.Task] = None
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         # On a mesh backend, GLOBAL keys owned by THIS node serve from the
@@ -273,6 +285,10 @@ class Service:
         self.multi_region_mgr.start()
         if self._collective_loop is not None:
             self._collective_loop.start()
+        if self.leases is not None:
+            self._lease_sweep_task = asyncio.ensure_future(
+                self._lease_sweep_loop()
+            )
         # Warm the jitted device step so the first client request doesn't
         # pay XLA compilation (20-40s cold) inside an RPC deadline.
         loop = asyncio.get_running_loop()
@@ -1198,6 +1214,186 @@ class Service:
         t.add_done_callback(self._shadow_tasks.discard)
 
     # ------------------------------------------------------------------
+    # client-side admission leases (runtime/lease.py; docs/leases.md)
+    # ------------------------------------------------------------------
+    def spawn_task(self, coro) -> None:
+        """Fire-and-forget a coroutine on the service loop, tracked so
+        shutdown can await it (the shadow-task discipline)."""
+        t = asyncio.ensure_future(coro)
+        self._shadow_tasks.add(t)
+        t.add_done_callback(self._shadow_tasks.discard)
+
+    async def _lease_sweep_loop(self) -> None:
+        """Periodic grant-expiry sweep: lapsed holders are revoked and a
+        key's carve slot drops once its last holder is gone, so the
+        owner re-collects un-burned allowance without waiting for a
+        reconcile that may never come (a dead holder)."""
+        interval = max(self.cfg.lease.ttl_ms / 2000.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.leases.sweep_apply()
+            except Exception as e:  # noqa: BLE001 — keep the cadence
+                log.warning("lease sweep failed: %s", e)
+
+    def _split_by_owner(self, keys: Sequence[str]):
+        """(owned indices, {addr: (peer, indices)}) for a key list —
+        the lease/reconcile ownership split.  A pool-empty or
+        single-node picker owns everything locally."""
+        owned: List[int] = []
+        by_peer: Dict[str, Tuple[PeerClient, List[int]]] = {}
+        single = self.local_picker.size() == 0
+        for i, key in enumerate(keys):
+            if single:
+                owned.append(i)
+                continue
+            try:
+                peer = self.get_peer(key)
+            except PoolEmptyError:
+                owned.append(i)
+                continue
+            if peer.info().is_owner:
+                owned.append(i)
+            else:
+                addr = peer.info().grpc_address
+                by_peer.setdefault(addr, (peer, []))[1].append(i)
+        return owned, by_peer
+
+    async def lease(
+        self, client_id: str, reqs: Sequence[RateLimitReq]
+    ) -> List[LeaseGrant]:
+        """Grant leases for the keys this node owns; forward the rest
+        to their owners (the edge-daemon proxy role — a LeasedClient
+        talks to ONE daemon and the ring routes its grants).  Grants
+        come back in request order; an unreachable owner refuses
+        rather than errors, so the client degrades to per-call checks
+        transparently."""
+        if self.leases is None:
+            return [
+                LeaseGrant(
+                    key=r.hash_key(), limit=r.limit,
+                    refusal="leases disabled",
+                )
+                for r in reqs
+            ]
+        out: List[Optional[LeaseGrant]] = [None] * len(reqs)
+        owned, by_peer = self._split_by_owner(
+            [r.hash_key() for r in reqs]
+        )
+        if owned:
+            grants = await self.leases.grant(
+                client_id, [reqs[i] for i in owned]
+            )
+            for i, g in zip(owned, grants):
+                out[i] = g
+
+        async def forward(peer: PeerClient, idx: List[int]) -> None:
+            try:
+                grants = await peer.lease(
+                    client_id, [reqs[i] for i in idx]
+                )
+                for i, g in zip(idx, grants):
+                    out[i] = g
+            except Exception as e:  # noqa: BLE001 — refuse, degrade
+                for i in idx:
+                    out[i] = LeaseGrant(
+                        key=reqs[i].hash_key(), limit=reqs[i].limit,
+                        refusal=f"owner unreachable: {e}",
+                    )
+
+        if by_peer:
+            await asyncio.gather(
+                *(forward(p, idx) for p, idx in by_peer.values())
+            )
+        return [
+            g if g is not None else LeaseGrant(refusal="not routed")
+            for g in out
+        ]
+
+    async def reconcile(
+        self, client_id: str, items: Sequence
+    ) -> List[LeaseGrant]:
+        """Apply burned-hit reconciliation for the keys this node owns;
+        forward the rest to their owners.  One grant per item in item
+        order (allowance 0 unless the item asked to renew)."""
+        if self.leases is None:
+            return [
+                LeaseGrant(
+                    key=it.request.hash_key(), limit=it.request.limit,
+                    refusal="leases disabled",
+                )
+                for it in items
+            ]
+        from dataclasses import replace as dc_replace
+
+        out: List[Optional[LeaseGrant]] = [None] * len(items)
+        owned, by_peer = self._split_by_owner(
+            [it.request.hash_key() for it in items]
+        )
+        if owned:
+            grants = await self.leases.reconcile(
+                client_id, [items[i] for i in owned]
+            )
+            for i, g in zip(owned, grants):
+                out[i] = g
+
+        # Non-owned burned hits ride GlobalManager.queue_hit — the
+        # at-most-once aggregation whose flush re-queues on provably-
+        # unsent failures, so a holder's burn survives an owner
+        # partition and converges after heal (a direct forward would
+        # have to drop it on any failure).  Only the release/renew
+        # bookkeeping forwards to the owner's LeaseManager, with hits
+        # zeroed so they cannot double-apply.
+        for _peer, idx in by_peer.values():
+            for i in idx:
+                if items[i].request.hits > 0:
+                    self.global_mgr.queue_hit(
+                        dc_replace(items[i].request)
+                    )
+
+        async def forward(peer: PeerClient, idx: List[int]) -> None:
+            if not any(
+                items[i].release or items[i].renew for i in idx
+            ):
+                # Burn-only items already rode queue_hit — nothing
+                # for the owner's LeaseManager to learn.
+                for i in idx:
+                    out[i] = LeaseGrant(
+                        key=items[i].request.hash_key(),
+                        limit=items[i].request.limit,
+                    )
+                return
+            stripped = [
+                dc_replace(
+                    items[i],
+                    request=dc_replace(items[i].request, hits=0),
+                )
+                for i in idx
+            ]
+            try:
+                grants = await peer.reconcile(client_id, stripped)
+                for i, g in zip(idx, grants):
+                    out[i] = g
+            except Exception as e:  # noqa: BLE001
+                # Renewals refuse (the client degrades); a lost release
+                # is re-collected by the owner's TTL sweep.
+                for i in idx:
+                    out[i] = LeaseGrant(
+                        key=items[i].request.hash_key(),
+                        limit=items[i].request.limit,
+                        refusal=f"owner unreachable: {e}",
+                    )
+
+        if by_peer:
+            await asyncio.gather(
+                *(forward(p, idx) for p, idx in by_peer.values())
+            )
+        return [
+            g if g is not None else LeaseGrant(refusal="not routed")
+            for g in out
+        ]
+
+    # ------------------------------------------------------------------
     # peer-facing API (server side)
     # ------------------------------------------------------------------
     async def get_peer_rate_limits(
@@ -1375,6 +1571,12 @@ class Service:
         if self._closed:
             return
         self._closed = True
+        if self._lease_sweep_task is not None:
+            self._lease_sweep_task.cancel()
+            await asyncio.gather(
+                self._lease_sweep_task, return_exceptions=True
+            )
+            self._lease_sweep_task = None
         if self._collective_loop is not None:
             await self._collective_loop.close()
         await self.global_mgr.close()

@@ -2,8 +2,8 @@
 cluster (the compressed version of tests/test_chaos.py +
 tests/test_hotkey.py).
 
-Scenarios (--scenario storm|hotkey|all; default storm — the original
-job; CI runs hotkey as its own required step):
+Scenarios (--scenario storm|hotkey|lease|all; default storm — the
+original job; CI runs hotkey and lease as their own required steps):
 
   storm   a seeded storm of client/server faults (>=30% of peer RPCs
           fail) with breakers + `local_shadow` degraded mode armed:
@@ -20,6 +20,17 @@ job; CI runs hotkey as its own required step):
           sheddable class drops with retry-after while an unmatched
           class serves), and after the skew clears the hot-set demotes
           to empty with the widening fully collapsed.
+
+  lease   the client-side admission bound under partition
+          (docs/leases.md): a LeasedClient holding a grant is cut off
+          from the key's owner; it burns EXACTLY its remaining
+          allowance with zero RPCs and never one hit more, direct
+          traffic saturates the authoritative row, and total admission
+          lands exactly on limit x (1 + holders x fraction).  After
+          heal, a fresh key proves burned hits reconcile into the
+          owner's row exactly once (queue_hit at-most-once through the
+          proxy daemon), and the owner re-collects: released grants
+          drop the carve slot.
 
 On any failure each daemon's flight recorder dumps its ring to
 GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
@@ -397,11 +408,214 @@ def hotkey_scenario(seed: int) -> None:
         cluster.stop()
 
 
+def lease_scenario(seed: int) -> None:
+    """The partitioned lease holder (docs/leases.md acceptance)."""
+    from gubernator_tpu.client import LeasedClient, V1Client
+    from gubernator_tpu.core.config import (
+        CircuitConfig,
+        DaemonConfig,
+        LeaseConfig,
+    )
+    from gubernator_tpu.core.types import RateLimitReq, Status
+    from gubernator_tpu.runtime.lease import LEASE_SUFFIX
+    from gubernator_tpu.testing import ChaosInjector, ChaosPlan, Cluster
+
+    limit = 200
+    fraction, holders = 0.25, 1
+    allowance = int(limit * fraction)  # 50
+    lease_cfg = LeaseConfig(
+        fraction=fraction, ttl_ms=60_000, max_holders=holders,
+        reconcile_ms=300, low_water=0.0,
+    )
+    injector = ChaosInjector(ChaosPlan(seed=seed))
+    injector.set_active(False)  # boot runs clean
+    cluster = Cluster.start_with(
+        ["", "", ""],
+        conf_template=DaemonConfig(
+            lease=lease_cfg,
+            # Fast breaker so post-heal half-open probes fit the budget.
+            circuit=CircuitConfig(
+                failure_threshold=3, base_backoff_s=0.1,
+                max_backoff_s=1.0, jitter=0.2,
+            ),
+            chaos=injector,
+            flightrec=True,
+            flightrec_dir=os.environ.get(
+                "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+            ),
+        ),
+    )
+    try:
+        d0 = cluster.daemons[0]
+        # A key owned by another daemon — d0 is the holder's proxy.
+        key = next(
+            f"L{i}" for i in range(1000)
+            if not d0.service.get_peer(f"lease_L{i}").info().is_owner
+        )
+        hash_key = f"lease_{key}"
+        owner = cluster.owner_daemon_of(hash_key)
+        req = RateLimitReq(name="lease", unique_key=key, hits=1,
+                           limit=limit, duration=60_000)
+
+        def admitted_of(resps):
+            return sum(
+                1 for r in resps
+                if r.error == "" and r.status == Status.UNDER_LIMIT
+            )
+
+        lc = LeasedClient(
+            d0.grpc_address, lease=lease_cfg, client_id="chaos-holder"
+        )
+        admitted = 0
+        try:
+            # Acquire the grant pre-partition.  The first check falls
+            # back through the forward path (1 authoritative hit).
+            admitted += admitted_of(lc.get_rate_limits([req]))
+            deadline = time.monotonic() + 10.0
+            while not any(
+                v.allowance_left > 0 for v in lc.table._leases.values()
+            ):
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"grant never arrived: {lc.stats()}"
+                    )
+                time.sleep(0.05)
+
+            # PARTITION the owner away from the holder's proxy.
+            injector.set_active(True)
+            injector.partition(
+                {owner.grpc_address},
+                {d.grpc_address for d in cluster.daemons
+                 if d is not owner},
+            )
+
+            # The partitioned holder burns its full grant — and NEVER
+            # more: once the allowance is gone, fallbacks through the
+            # dead forward path answer errors, not admissions.
+            local_before = lc.stats()["local_admitted"]
+            for _ in range(allowance + 30):
+                admitted += admitted_of(lc.get_rate_limits([req]))
+            local_burned = lc.stats()["local_admitted"] - local_before
+            assert local_burned == allowance, (
+                f"holder burned {local_burned}, grant was {allowance}"
+            )
+
+            # Direct traffic at the owner saturates the authoritative
+            # row (its own clients are unaffected by the partition).
+            cl_o = V1Client(owner.grpc_address)
+            try:
+                for _ in range(limit + 20):
+                    admitted += admitted_of(
+                        cl_o.get_rate_limits([req], timeout=30)
+                    )
+                bound = int(limit * (1 + holders * fraction))  # 250
+                assert admitted == bound, (
+                    f"admitted {admitted} != bound {bound}"
+                )
+                # Saturated: every further check everywhere denies.
+                extra = admitted_of(
+                    cl_o.get_rate_limits([req], timeout=30)
+                ) + admitted_of(lc.get_rate_limits([req]))
+                assert extra == 0, "admission past the proven bound"
+            finally:
+                cl_o.close()
+
+            # HEAL.  Phase B on a FRESH key owned by the same daemon:
+            # burned hits must reconcile into the owner's row exactly
+            # once (queue_hit at-most-once through the proxy).
+            injector.heal()
+            key2 = next(
+                f"M{i}" for i in range(1000)
+                if cluster.owner_daemon_of(f"lease_M{i}") is owner
+            )
+            req2 = RateLimitReq(name="lease", unique_key=key2, hits=1,
+                                limit=limit, duration=60_000)
+            # Drive checks while waiting: each fallback re-requests the
+            # grant once the refusal cooldown lapses (the d0->owner
+            # breaker needs its half-open probe after the partition),
+            # and every direct admission is counted for the
+            # convergence arithmetic below.
+            direct2 = 0
+            deadline = time.monotonic() + 20.0
+            while not any(
+                v.allowance_left > 0
+                for k, v in lc.table._leases.items()
+                if k == f"lease_{key2}"
+            ):
+                direct2 += admitted_of(lc.get_rate_limits([req2]))
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"post-heal grant never arrived: {lc.stats()}"
+                    )
+                time.sleep(0.1)
+            burn2 = 20
+            for _ in range(burn2):
+                r = lc.get_rate_limits([req2])[0]
+                assert (r.metadata or {}).get("lease") == "local", r
+
+            def converged():
+                row = owner.service.backend.get_cache_item(
+                    f"lease_{key2}"
+                )
+                return (
+                    row is not None
+                    and limit - int(row.remaining) == burn2 + direct2
+                )
+
+            deadline = time.monotonic() + 20.0
+            while not converged():
+                if time.monotonic() > deadline:
+                    row = owner.service.backend.get_cache_item(
+                        f"lease_{key2}"
+                    )
+                    raise AssertionError(
+                        "burned hits never reconverged: row="
+                        f"{row} expected {burn2 + direct2} applied"
+                    )
+                time.sleep(0.1)
+        finally:
+            lc.close()
+
+        # Owner re-collects on heal: close() released the grants, so
+        # the carve slots drop (RESET_REMAINING removes the rows) and
+        # no holder state survives.
+        deadline = time.monotonic() + 15.0
+        while True:
+            slots = [
+                owner.service.backend.get_cache_item(
+                    f"lease_{k}" + LEASE_SUFFIX
+                )
+                for k in (key, key2)
+            ]
+            vars_ = owner.service.leases.debug_vars()
+            if all(s is None for s in slots) and not vars_["keys"]:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"owner never re-collected: slots={slots} "
+                    f"holders={vars_['keys']}"
+                )
+            time.sleep(0.1)
+
+        print(
+            f"lease smoke OK: seed={seed} key={hash_key} "
+            f"owner={owner.grpc_address} admitted={admitted} "
+            f"(bound {int(limit * (1 + holders * fraction))}), "
+            f"holder burned {allowance}/{allowance} under partition, "
+            f"reconverged +{burn2} after heal, slots re-collected"
+        )
+    except BaseException:
+        _dump_flightrec(cluster, "lease-smoke-failure")
+        raise
+    finally:
+        cluster.stop()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument(
-        "--scenario", choices=("storm", "hotkey", "all"),
+        "--scenario", choices=("storm", "hotkey", "lease", "all"),
         default="storm",
     )
     args = ap.parse_args()
@@ -409,6 +623,8 @@ def main() -> None:
         storm_scenario(args.seed)
     if args.scenario in ("hotkey", "all"):
         hotkey_scenario(args.seed)
+    if args.scenario in ("lease", "all"):
+        lease_scenario(args.seed)
 
 
 if __name__ == "__main__":

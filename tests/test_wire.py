@@ -29,7 +29,7 @@ def test_method_paths():
     ]
     psvc = peers_pb2.DESCRIPTOR.services_by_name["PeersV1"]
     assert [m.name for m in psvc.methods] == [
-        "GetPeerRateLimits", "UpdatePeerGlobals",
+        "GetPeerRateLimits", "UpdatePeerGlobals", "Lease", "Reconcile",
     ]
 
 
@@ -51,6 +51,17 @@ def test_field_numbers_match_reference():
     f = peers_pb2.UpdatePeerGlobal.DESCRIPTOR.fields_by_name
     assert {k: v.number for k, v in f.items()} == {
         "key": 1, "status": 2, "algorithm": 3,
+    }
+    # Lease plane (docs/leases.md) — this repo's own wire surface; the
+    # numbers are the compatibility contract for compiled clients.
+    f = peers_pb2.LeaseGrant.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "key": 1, "allowance": 2, "expires_at": 3, "reset_time": 4,
+        "limit": 5, "refusal": 6,
+    }
+    f = peers_pb2.ReconcileItem.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "request": 1, "release": 2, "renew": 3,
     }
 
 

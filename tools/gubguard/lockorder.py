@@ -44,6 +44,8 @@ CLASS_LOCK_MAP = {
     ("Store", "_lock"): "store._lock",
     ("MockStore", "_lock"): "store._lock",
     ("HotKeyTracker", "_lock"): "hotkey._lock",
+    ("LeaseManager", "_lock"): "lease._lock",
+    ("_LeaseTable", "_lock"): "lease.client._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
@@ -60,6 +62,8 @@ VAR_ALIAS = {
     "store": "store",
     "hotkeys": "hotkey",
     "hk": "hotkey",
+    "leases": "lease",
+    "lm": "lease",
     "flightrec": "flightrec",
     "fr": "flightrec",
 }
@@ -91,6 +95,13 @@ RANK = {
     # records fire after release) — ranked just before the
     # record-anything tail locks.
     "hotkey._lock": 55,
+    # lease._lock (runtime/lease.py holder dicts) sits with hotkey: it
+    # is taken from grant/reconcile paths holding nothing, guards only
+    # dict state, and is NEVER held across an await or device work (the
+    # carve rides _check_local outside it).  The client-side twin
+    # (lease.client._lock, client._LeaseTable) has the same contract.
+    "lease._lock": 56,
+    "lease.client._lock": 57,
     "flightrec._lock": 60,
     # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
     # flightrec: span bookkeeping may run under ANY layer's lock (a span

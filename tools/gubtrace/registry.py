@@ -33,6 +33,12 @@ probe ops), so the golden primitive budgets are unchanged — the
 completeness checker (AST scan for module-level jax.jit) stays the
 authority that any future chained-dispatch kernel must land in this
 file.
+
+The lease plane (docs/leases.md) likewise adds NO kernels: grants,
+reconciles, and carve-slot drops are host/client-side orchestration
+whose device work is ordinary checks through the already-registered
+step entrypoints (the `.lease-grant` slot is a normal table row), so
+the 20 verified kernels and their goldens are unchanged.
 """
 from __future__ import annotations
 
