@@ -316,6 +316,99 @@ def lease_config_from_env() -> LeaseConfig:
         ) from None
 
 
+@dataclass
+class ReshardConfig:
+    """Elastic membership / live slot migration (runtime/reshard.py;
+    docs/resharding.md; no reference analog — the Go daemon's peer
+    remap silently orphans every moved key's counters, so at scale
+    every autoscaling event is a mass limit reset).
+
+    When `service.set_peers` computes a hash remap, the OLD owner of
+    every moved arc drives a per-destination handoff
+    (PREPARE -> DRAIN -> TRANSFER -> CUTOVER -> RELEASE): packed table
+    rows stream to the new owner on the peers wire (Migrate RPCs) and
+    the moved slots are cleared atomically with their extraction.
+    During the window the new owner forwards covered checks back to
+    the still-authoritative old owner; once TRANSFER is announced it
+    serves them from a bounded `<key>.handoff-shadow` carve at
+    `handoff_fraction x limit` instead, so cluster-wide admission for
+    a moved key is bounded by `limit x (1 + handoff_fraction)` — the
+    local_shadow/mirror/lease algebra with a remap (not death or
+    pressure) as the gate.  Shadow burns are applied to the
+    authoritative row at cutover (counters conserved, never inflated).
+    """
+
+    enabled: bool = True
+    # Fraction of the limit the NEW owner may admit from the local
+    # handoff shadow while a covered key's row is in flight.
+    handoff_fraction: float = 0.25
+    # Rows per Migrate RPC chunk (bounded by the 4MB message cap).
+    chunk_rows: int = 1024
+    # New-owner watchdog: if the old owner goes silent mid-handoff for
+    # this long, self-cutover (missing rows conservatively reset).
+    timeout_s: float = 10.0
+    # How long the old owner keeps forwarding stale-routed checks for
+    # released arcs after cutover (covers discovery convergence).
+    release_linger_s: float = 10.0
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.handoff_fraction <= 1.0:
+            raise ValueError(
+                "reshard handoff_fraction must be in (0, 1], got "
+                f"{self.handoff_fraction}"
+            )
+        if self.chunk_rows < 1:
+            raise ValueError(
+                f"reshard chunk_rows must be >= 1, got {self.chunk_rows}"
+            )
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"reshard timeout_s must be > 0, got {self.timeout_s}"
+            )
+        if self.release_linger_s < 0:
+            raise ValueError(
+                "reshard release_linger_s must be >= 0, got "
+                f"{self.release_linger_s}"
+            )
+
+
+def reshard_config_from_env() -> ReshardConfig:
+    """The reshard plane's env parse (same contract as
+    hotkey_config_from_env): validation errors name the env surface at
+    startup instead of crashing a constructor later."""
+    try:
+        return ReshardConfig(
+            enabled=_env("GUBER_RESHARD_ENABLED", "true").lower()
+            not in ("0", "false", "no"),
+            handoff_fraction=float(
+                _env("GUBER_RESHARD_FRACTION", "0.25")
+            ),
+            chunk_rows=_env_int("GUBER_RESHARD_CHUNK", 1024),
+            timeout_s=_env_float_s("GUBER_RESHARD_TIMEOUT", 10.0),
+            release_linger_s=_env_float_s(
+                "GUBER_RESHARD_RELEASE_LINGER", 10.0
+            ),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "reshard env config (GUBER_RESHARD_FRACTION, "
+            "GUBER_RESHARD_CHUNK, GUBER_RESHARD_TIMEOUT, "
+            f"GUBER_RESHARD_RELEASE_LINGER): {e}"
+        ) from None
+
+
+def peer_debounce_ms_from_env() -> int:
+    """Discovery-update coalescing window (GUBER_PEER_DEBOUNCE_MS): an
+    etcd/k8s watch storm delivering N membership events within the
+    window triggers ONE remap (latest peer set wins), not N
+    interleaved rebuilds.  0 disables coalescing (every event applies,
+    still serialized latest-wins)."""
+    return _require_min(
+        "GUBER_PEER_DEBOUNCE_MS",
+        _env_int("GUBER_PEER_DEBOUNCE_MS", 100), 0,
+    )
+
+
 # Fast-lane drain disciplines (runtime/fastpath.py; docs/ring.md):
 #   classic    — strict depth-1: every merge's dispatch AND fetch
 #                serialize end to end (the pre-PR5 discipline);
@@ -457,6 +550,9 @@ class Config:
     hotkey: HotKeyConfig = field(default_factory=HotKeyConfig)
     # Client-side admission leases (runtime/lease.py; docs/leases.md).
     lease: LeaseConfig = field(default_factory=LeaseConfig)
+    # Elastic membership / live slot migration (runtime/reshard.py;
+    # docs/resharding.md).
+    reshard: ReshardConfig = field(default_factory=ReshardConfig)
 
 
 @dataclass
@@ -559,6 +655,20 @@ class DaemonConfig:
     # Client-side admission leases (runtime/lease.py; docs/leases.md):
     # bounded local allowances on the peers wire.
     lease: LeaseConfig = field(default_factory=LeaseConfig)
+    # Elastic membership / live slot migration (runtime/reshard.py;
+    # docs/resharding.md): a remap streams moved rows old owner -> new
+    # owner instead of orphaning them.
+    reshard: ReshardConfig = field(default_factory=ReshardConfig)
+    # Discovery-update coalescing window in ms (GUBER_PEER_DEBOUNCE_MS):
+    # rapid watch events within the window apply as ONE latest-wins
+    # remap.  0 = apply every event (still serialized).
+    peer_debounce_ms: int = 100
+    # Graceful scale-down: on daemon close, migrate every owned row to
+    # its next owner (the ring without this node) BEFORE stopping the
+    # listeners — the autoscaler's preStop/SIGTERM drain.  Off by
+    # default: a crash-stop must stay cheap, and tests tear clusters
+    # down constantly.
+    reshard_drain_on_close: bool = False
     # Chaos plane (testing/chaos.py): a seeded fault plan injected at
     # the peer-client and daemon RPC boundaries.  `chaos_plan` is a JSON
     # plan file (empty = no chaos — the production default); `chaos`
@@ -889,6 +999,11 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         shadow_fraction=shadow_fraction,
         hotkey=hotkey_config_from_env(),
         lease=lease_config_from_env(),
+        reshard=reshard_config_from_env(),
+        peer_debounce_ms=peer_debounce_ms_from_env(),
+        reshard_drain_on_close=_env(
+            "GUBER_RESHARD_DRAIN_ON_CLOSE", "false"
+        ).lower() in ("1", "true", "yes"),
         chaos_plan=_env("GUBER_CHAOS_PLAN", ""),
         chaos_seed=_env_int("GUBER_CHAOS_SEED", 0),
     )

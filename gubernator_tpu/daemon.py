@@ -37,6 +37,7 @@ _GRPC_CODES = {
     "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
     "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
     "INTERNAL": grpc.StatusCode.INTERNAL,
+    "FAILED_PRECONDITION": grpc.StatusCode.FAILED_PRECONDITION,
 }
 
 
@@ -245,6 +246,30 @@ class _PeersServicer:
             grants=[grpc_api.lease_grant_to_pb(g) for g in grants]
         )
 
+    async def Handoff(self, request, context):
+        """Live resharding control plane (docs/resharding.md): the old
+        owner announces a handoff phase; we ack and adjust how covered
+        keys are served."""
+        accepted, state = await self.d.service.handoff(
+            request.from_address, request.epoch, request.phase,
+            request.total_rows,
+        )
+        return peers_pb2.HandoffResp(accepted=accepted, state=state)
+
+    async def Migrate(self, request, context):
+        """One chunk of packed table rows for an active inbound
+        handoff; injected only where the key is absent here."""
+        try:
+            injected, skipped = await self.d.service.migrate(
+                request.from_address, request.epoch, request.rows,
+                request.final,
+            )
+        except ApiError as e:
+            await context.abort(
+                _GRPC_CODES.get(e.code, grpc.StatusCode.INTERNAL), str(e)
+            )
+        return peers_pb2.MigrateResp(injected=injected, skipped=skipped)
+
 
 class Daemon:
     """One gubernator-tpu node."""
@@ -308,6 +333,17 @@ class Daemon:
         self._http_runner: Optional[web.AppRunner] = None
         self._pool = None
         self._peers: List[PeerInfo] = []
+        # Discovery-update applier state: ONE task applies membership
+        # updates in order (latest wins), so rapid watch events can
+        # never interleave their set_peers rebuilds; direct callers
+        # (the cluster fixture) serialize through the same lock.
+        self._set_peers_lock = asyncio.Lock()
+        self._pending_peers: Optional[List[PeerInfo]] = None
+        self._peers_event: Optional[asyncio.Event] = None
+        self._peer_update_task: Optional[asyncio.Task] = None
+        # Monotone count of APPLIED membership updates (observability +
+        # the watch-storm coalescing test).
+        self.peer_updates_applied = 0
         self.grpc_address = self.conf.grpc_listen_address
         self.http_address = self.conf.http_listen_address
 
@@ -457,13 +493,35 @@ class Daemon:
             self.grpc_address, self.http_address,
         )
 
+    async def drain(self) -> int:
+        """Graceful scale-down (docs/resharding.md): migrate every
+        owned row to the ring without this node, while all listeners
+        stay up — the autoscaler's preStop/SIGTERM hook.  Call before
+        close(); returns rows shipped."""
+        if self.service is None:
+            return 0
+        return await self.service.drain_for_shutdown()
+
     async def close(self) -> None:
         # Order: stop taking traffic (discovery, then listeners with a
         # drain grace) BEFORE tearing down the service — late requests must
         # drain, not crash into a closed device executor.
+        if self._peer_update_task is not None:
+            self._peer_update_task.cancel()
+            await asyncio.gather(
+                self._peer_update_task, return_exceptions=True
+            )
+            self._peer_update_task = None
         if self._pool is not None:
             await self._pool.close()
             self._pool = None
+        if getattr(self.conf, "reshard_drain_on_close", False):
+            # Migrate owned rows out while the listeners still serve
+            # (peers keep forwarding through the handoff window).
+            try:
+                await self.drain()
+            except Exception as e:  # noqa: BLE001 — close must proceed
+                log.warning("drain on close failed: %s", e)
         if self._grpc_tls_proxy is not None:
             # Refuse NEW connections on the real socket before the gRPC
             # drain (a mid-shutdown dial must see connection-refused, not
@@ -714,6 +772,13 @@ class Daemon:
                 # Client-side admission leases (docs/leases.md): grant/
                 # refusal counters, per-key holder expiries, knobs.
                 out["leases"] = s.leases.debug_vars()
+            if s.reshard is not None:
+                # Live resharding (docs/resharding.md): per-peer
+                # handoff phases, row counters, shadow burns.
+                out["reshard"] = {
+                    **s.reshard.debug_vars(),
+                    "peer_updates_applied": self.peer_updates_applied,
+                }
         fp = self.fastpath
         if fp is not None:
             # Per-lane drain/pipeline counters (drains, overlap_drains,
@@ -743,7 +808,9 @@ class Daemon:
 
     async def set_peers(self, peers: Sequence[PeerInfo]) -> None:
         """Mark ourselves in the peer list and hand it to the service
-        (daemon.go:375-385 sets IsOwner on the local instance)."""
+        (daemon.go:375-385 sets IsOwner on the local instance).
+        Serialized: concurrent callers (the discovery applier, the
+        cluster fixture) apply one at a time, in call order."""
         me = self.advertise_address()
         marked = [
             PeerInfo(
@@ -754,29 +821,65 @@ class Daemon:
             )
             for p in peers
         ]
-        self._peers = marked
-        await self.service.set_peers(marked)
+        async with self._set_peers_lock:
+            self._peers = marked
+            await self.service.set_peers(marked)
+            self.peer_updates_applied += 1
 
     def peers(self) -> List[PeerInfo]:
         return list(self._peers)
+
+    async def _apply_peer_updates(self) -> None:
+        """The discovery-update applier: ONE long-lived task drains
+        membership events latest-wins, so an etcd/k8s watch storm of N
+        events within the GUBER_PEER_DEBOUNCE_MS window triggers ONE
+        remap, not N interleaved rebuilds (and out-of-order application
+        is structurally impossible — there is exactly one applier)."""
+        assert self._peers_event is not None
+        debounce_s = max(self.conf.peer_debounce_ms, 0) / 1000.0
+        while True:
+            await self._peers_event.wait()
+            if debounce_s:
+                # Coalescing window: later events within it simply
+                # overwrite _pending_peers (latest wins).
+                await asyncio.sleep(debounce_s)
+            self._peers_event.clear()
+            peers, self._pending_peers = self._pending_peers, None
+            if peers is None:
+                continue
+            try:
+                await self.set_peers(peers)
+            except Exception as e:  # noqa: BLE001 — keep the applier
+                log.warning("peer update failed: %s", e)
 
     async def _start_discovery(self) -> None:
         kind = self.conf.peer_discovery_type
         if kind in ("none", ""):
             return
         loop = asyncio.get_running_loop()
+        self._peers_event = asyncio.Event()
+        # Keep a reference to the applier: a fire-and-forget task can
+        # be garbage-collected mid-flight, and close() must be able to
+        # cancel it.
+        self._peer_update_task = asyncio.ensure_future(
+            self._apply_peer_updates()
+        )
 
         def on_update(peers: Sequence[PeerInfo]) -> None:
             # Pools usually run on this loop, but some sources (etcd watch
             # callbacks) fire from background threads — route accordingly.
+            def submit() -> None:
+                self._pending_peers = list(peers)
+                self._peers_event.set()
+
             try:
                 running = asyncio.get_running_loop()
             except RuntimeError:
                 running = None
             if running is loop:
-                asyncio.ensure_future(self.set_peers(peers))
+                submit()
             else:
-                asyncio.run_coroutine_threadsafe(self.set_peers(peers), loop)
+                loop.call_soon_threadsafe(submit)
 
         if kind == "static":
             from gubernator_tpu.discovery.static import StaticPool

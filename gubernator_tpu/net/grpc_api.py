@@ -204,6 +204,16 @@ class PeersV1Stub:
             request_serializer=peers_pb.ReconcileReq.SerializeToString,
             response_deserializer=peers_pb.ReconcileResp.FromString,
         )
+        self.Handoff = channel.unary_unary(
+            f"/{PEERS_SERVICE}/Handoff",
+            request_serializer=peers_pb.HandoffReq.SerializeToString,
+            response_deserializer=peers_pb.HandoffResp.FromString,
+        )
+        self.Migrate = channel.unary_unary(
+            f"/{PEERS_SERVICE}/Migrate",
+            request_serializer=peers_pb.MigrateReq.SerializeToString,
+            response_deserializer=peers_pb.MigrateResp.FromString,
+        )
 
 
 # --------------------------------------------------------------------------
@@ -279,5 +289,20 @@ def peers_generic_handler(
             servicer.Reconcile,
             request_deserializer=peers_pb.ReconcileReq.FromString,
             response_serializer=peers_pb.ReconcileResp.SerializeToString,
+        )
+    # Live resharding (docs/resharding.md) — control-plane RPCs, so
+    # python protobuf is fine (Migrate chunks are seconds-scale bulk
+    # transfer, not the check path).  Optional like Lease/Reconcile.
+    if hasattr(servicer, "Handoff"):
+        handlers["Handoff"] = rpc(
+            servicer.Handoff,
+            request_deserializer=peers_pb.HandoffReq.FromString,
+            response_serializer=peers_pb.HandoffResp.SerializeToString,
+        )
+    if hasattr(servicer, "Migrate"):
+        handlers["Migrate"] = rpc(
+            servicer.Migrate,
+            request_deserializer=peers_pb.MigrateReq.FromString,
+            response_serializer=peers_pb.MigrateResp.SerializeToString,
         )
     return grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers)

@@ -626,6 +626,104 @@ class PeerClient:
         finally:
             self._track_inflight(-1)
 
+    async def handoff(
+        self, from_address: str, epoch: int, phase: str,
+        total_rows: int = 0,
+    ):
+        """One live-resharding control RPC (docs/resharding.md): the
+        old owner announces a handoff phase to this peer (the new
+        owner).  Returns (accepted, state).  Same shutdown/breaker/
+        chaos accounting as the broadcast path."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
+        self._track_inflight(+1)
+        try:
+            stub = await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
+            with tracing.span(
+                "peer.handoff", require_parent=True,
+                peer=self.peer_info.grpc_address, method="Handoff",
+                phase=phase,
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address, "Handoff"
+                        )
+                    req = peers_pb2.HandoffReq(
+                        from_address=from_address, epoch=epoch,
+                        phase=phase, total_rows=total_rows,
+                    )
+                    resp = await stub.Handoff(
+                        req, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
+                    )
+                except asyncio.CancelledError:
+                    self._record_cancelled("Handoff")
+                    raise
+            self._record_success()
+            return resp.accepted, resp.state
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
+    async def migrate(
+        self, from_address: str, epoch: int, rows, final: bool = False
+    ):
+        """One chunk of packed table rows streamed to this peer during
+        a handoff's TRANSFER phase.  Returns (injected, skipped).
+        Retry-safety belongs to the caller, but is structural here: the
+        receiver injects only where the key is absent, so a replayed
+        chunk can never double-apply."""
+        if self._shutdown:
+            raise PeerNotReadyError(
+                f"peer {self.peer_info.grpc_address} is shut down"
+            )
+        if self.breaker is not None and not self.breaker.would_allow():
+            raise self._shed("breaker_open")
+        self._track_inflight(+1)
+        try:
+            stub = await self._connect()
+            if self.breaker is not None and not self.breaker.allow():
+                raise self._shed("breaker_open")
+            with tracing.span(
+                "peer.migrate", require_parent=True,
+                peer=self.peer_info.grpc_address, method="Migrate",
+                rows=len(rows.key_hash),
+            ):
+                try:
+                    budget = await self._ensure_ready()
+                    if self.chaos is not None:
+                        await self.chaos.on_client(
+                            self.peer_info.grpc_address, "Migrate"
+                        )
+                    req = peers_pb2.MigrateReq(
+                        from_address=from_address, epoch=epoch,
+                        rows=rows, final=final,
+                    )
+                    resp = await stub.Migrate(
+                        req, timeout=budget,
+                        metadata=tracing.grpc_metadata(),
+                    )
+                except asyncio.CancelledError:
+                    self._record_cancelled("Migrate")
+                    raise
+            self._record_success()
+            return resp.injected, resp.skipped
+        except grpc.aio.AioRpcError as e:
+            self._record_error(str(e))
+            raise
+        finally:
+            self._track_inflight(-1)
+
     async def shutdown(self) -> None:
         """Stop accepting work, wait for in-flight requests to drain, then
         close the channel (peer_client.go:512-546)."""

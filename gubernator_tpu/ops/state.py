@@ -81,3 +81,147 @@ def table_to_host(table: SlotTable) -> dict:
 
 def table_from_host(arrs: dict) -> SlotTable:
     return SlotTable(**{f: jnp.asarray(arrs[f]) for f in SlotTable._fields})
+
+
+# --------------------------------------------------------------------------
+# Live slot migration (docs/resharding.md): row extract/inject kernels.
+#
+# A peer join/leave remaps the consistent hash; the rows whose arcs moved
+# must LEAVE the old owner's table (or it would keep serving a key it no
+# longer owns — an orphaned slot) and LAND in the new owner's, preserving
+# remaining/t0/expire_at exactly so the limit window survives the remap.
+# Extract is gather+clear fused in ONE donated kernel so the critical
+# section under backend._lock is a single dispatch: between the gather
+# and the clear nothing else can touch the table, making the handoff's
+# "counters conserved" claim a per-row atomicity fact, not a protocol
+# hope.  Inject is upsert-IF-ABSENT: a late or replayed Migrate chunk
+# can never clobber state the receiver already created (the receiver's
+# row is newer by construction — it was written after cutover or by a
+# racing authoritative check).
+# --------------------------------------------------------------------------
+
+
+def migrate_extract_impl(
+    table: SlotTable,
+    h: jax.Array,       # int64[B] key fingerprints; 0 = inactive lane
+    now: jax.Array,
+    ways: int = 8,
+):
+    """Probe `h`, gather each found row's fields, and CLEAR the matched
+    slots (key=0, expire_at=0) in the same step.  Returns
+    (new_table, packed int64[10, B] in ops.step.GATHER_ROW_FIELDS order,
+    float64[B] remaining_f)."""
+    S = table.key.shape[0]
+    nb = S // ways
+    now = jnp.asarray(now, dtype=jnp.int64)
+    bucket = (
+        h.astype(jnp.uint64) & jnp.uint64(nb - 1)
+    ).astype(jnp.int64)
+    sidx = (
+        bucket[:, None] * ways
+        + jnp.arange(ways, dtype=jnp.int64)[None, :]
+    )
+    match = (
+        (table.key[sidx] == h[:, None])
+        & (h[:, None] != 0)
+        & (table.expire_at[sidx] > now)
+    )
+    found = match.any(axis=1)
+    slot = bucket * ways + jnp.argmax(match, axis=1)
+    src = jnp.where(found, slot, 0)
+
+    def g(arr):
+        return arr[src]
+
+    packed = jnp.stack([
+        found.astype(jnp.int64),
+        g(table.kind).astype(jnp.int64),
+        g(table.algo).astype(jnp.int64),
+        g(table.limit),
+        g(table.duration),
+        g(table.remaining),
+        g(table.t0),
+        g(table.status).astype(jnp.int64),
+        g(table.burst),
+        g(table.expire_at),
+    ])
+    rf = g(table.remaining_f)
+    # Clear: drop the fingerprint AND the expiry so the slot reads as
+    # empty to every probe/locate and as a first-choice victim.
+    tgt = jnp.where(found, slot, S)
+    new_table = table._replace(
+        key=table.key.at[tgt].set(0, mode="drop"),
+        expire_at=table.expire_at.at[tgt].set(0, mode="drop"),
+    )
+    return new_table, packed, rf
+
+
+migrate_extract = jax.jit(
+    migrate_extract_impl, static_argnames=("ways",), donate_argnums=(0,)
+)
+
+
+def migrate_inject_impl(
+    table: SlotTable,
+    rows,  # ops.step.BucketRows; key_hash 0 = inactive lane
+    now: jax.Array,
+    ways: int = 8,
+):
+    """Upsert migrated rows where the key is absent; where it is
+    already resident, MERGE by subtracting the migrated row's consumed
+    budget (limit - remaining, clamped at 0) from the resident row —
+    discovery gives no ordering guarantees, so a receiver may have
+    served a moved key (fresh row) before its migrated row arrives, and
+    keeping either row alone would lose the other's admissions.  The
+    merge conserves: total consumption is the sum of both rows',
+    clamped at the limit — it can only LOWER remaining, never inflate
+    admission.  Returns (new_table, bool[B] resident-before mask); the
+    caller must guard against chunk replays (a re-delivered chunk would
+    re-subtract) — runtime/reshard.py keys delivered fingerprints per
+    handoff epoch."""
+    # Runtime import: ops.step imports this module at load, so the
+    # dependency must stay one-way at module scope.
+    from gubernator_tpu.ops.step import load_rows_impl, probe_batch_impl
+
+    now = jnp.asarray(now, dtype=jnp.int64)
+    found, slot = probe_batch_impl(table, rows.key_hash, now, ways=ways)
+    masked = rows._replace(
+        key_hash=jnp.where(found, 0, rows.key_hash)
+    )
+    new_table = load_rows_impl(table, masked, now, ways=ways)
+    # Merge-on-conflict: the probe's slots index rows load_rows did not
+    # touch (conflict lanes were masked out of the upsert).
+    active = rows.key_hash != 0
+    conflict = found & active
+    consumed_i = jnp.maximum(rows.limit - rows.remaining, 0)
+    consumed_f = jnp.maximum(
+        rows.limit.astype(jnp.float64) - rows.remaining_f, 0.0
+    )
+    is_leaky = rows.algo == 1
+    src = jnp.where(conflict, slot, 0)
+    merged_rem = jnp.maximum(
+        new_table.remaining[src]
+        - jnp.where(is_leaky, 0, consumed_i),
+        0,
+    )
+    merged_rf = jnp.maximum(
+        new_table.remaining_f[src]
+        - jnp.where(is_leaky, consumed_f, 0.0),
+        0.0,
+    )
+    S = table.key.shape[0]
+    tgt = jnp.where(conflict, slot, S)
+    new_table = new_table._replace(
+        remaining=new_table.remaining.at[tgt].set(
+            merged_rem, mode="drop"
+        ),
+        remaining_f=new_table.remaining_f.at[tgt].set(
+            merged_rf, mode="drop"
+        ),
+    )
+    return new_table, found
+
+
+migrate_inject = jax.jit(
+    migrate_inject_impl, static_argnames=("ways",), donate_argnums=(0,)
+)

@@ -229,6 +229,149 @@ class PersistenceHost:
                 self._wt_next += 1
                 cond.notify_all()
 
+    # -- live slot migration (runtime/reshard.py; docs/resharding.md) ----
+    def key_snapshot(self):
+        """(key int64[S], kind int32[S], expire_at int64[S]) host view —
+        the reshard plane's remap-delta input (one fetch, no full-table
+        DMA)."""
+        with self._lock:
+            t = self.table
+            return (
+                np.asarray(t.key), np.asarray(t.kind),
+                np.asarray(t.expire_at),
+            )
+
+    def migrate_extract_rows(self, fps: np.ndarray):
+        """Atomically gather-and-clear the rows for int64 fingerprints
+        `fps`: returns (int64[10, n] in ops.step.GATHER_ROW_FIELDS
+        order — packed[0] is the found mask — and float64[n]
+        remaining_f).  Cleared rows read as empty to every probe from
+        the moment the lock releases, so the old owner can never serve
+        a migrated key from an orphaned slot.
+
+        Generic path (MeshBackend): a row gather plus an expire_at=0
+        re-upsert in ONE critical section — two dispatches, same
+        atomicity, riding the registered sharded gather/load kernels."""
+        from gubernator_tpu.ops.step import GATHER_ROW_FIELDS
+
+        n = len(fps)
+        now = self.clock.millisecond_now()
+        with self._lock:
+            token = self._gather_rows_dispatch(
+                np.asarray(fps, dtype=np.int64), now
+            )
+            packed, rf = self._gather_rows_finish(token, n)
+            found = packed[0] != 0
+            if found.any():
+                rows = [
+                    {
+                        "algo": int(packed[2][j]),
+                        "limit": int(packed[3][j]),
+                        "duration": int(packed[4][j]),
+                        "remaining": int(packed[5][j]),
+                        "remaining_f": float(rf[j]),
+                        "t0": int(packed[6][j]),
+                        "status": int(packed[7][j]),
+                        "burst": int(packed[8][j]),
+                        "expire_at": 0,  # the clear
+                    }
+                    for j in np.flatnonzero(found)
+                ]
+                hashes = [
+                    int(np.int64(fps[j]).view(np.uint64))
+                    for j in np.flatnonzero(found)
+                ]
+                self._bulk_upsert(rows, hashes, now)
+        assert packed.shape[0] == len(GATHER_ROW_FIELDS)
+        return packed, rf
+
+    def migrate_inject_rows(self, cols: Dict[str, np.ndarray]):
+        """Upsert migrated row columns (BucketRows field names) where
+        the key is absent; MERGE where it is resident — subtract the
+        migrated row's consumed budget from the resident row, clamped
+        at 0 (counters conserved, never inflated; a receiver may have
+        served a moved key before its row arrived).  Returns
+        (injected, merged).  The reshard manager guards chunk replays
+        per handoff epoch — a re-delivered chunk never reaches this.
+
+        Generic path (MeshBackend): probe + upsert + a gather/re-upsert
+        merge in one critical section over the registered sharded
+        kernels."""
+        n = len(cols["key_hash"])
+        now = self.clock.millisecond_now()
+        h64 = np.asarray(cols["key_hash"], dtype=np.int64)
+        hashes_u = [int(np.int64(h).view(np.uint64)) for h in h64]
+        with self._lock:
+            found = np.asarray(
+                self._found_mask([""] * n, hashes_u, now)
+            )
+            absent = ~found
+
+            def row_at(j, remaining, remaining_f):
+                return {
+                    "algo": int(cols["algo"][j]),
+                    "limit": int(cols["limit"][j]),
+                    "duration": int(cols["duration"][j]),
+                    "remaining": int(remaining),
+                    "remaining_f": float(remaining_f),
+                    "t0": int(cols["t0"][j]),
+                    "status": int(cols["status"][j]),
+                    "burst": int(cols["burst"][j]),
+                    "expire_at": int(cols["expire_at"][j]),
+                }
+
+            if absent.any():
+                idx = np.flatnonzero(absent)
+                self._bulk_upsert(
+                    [
+                        row_at(
+                            j, cols["remaining"][j],
+                            cols["remaining_f"][j],
+                        )
+                        for j in idx
+                    ],
+                    [hashes_u[j] for j in idx], now,
+                )
+            if found.any():
+                idx = np.flatnonzero(found)
+                token = self._gather_rows_dispatch(h64[idx], now)
+                packed, rf = self._gather_rows_finish(token, len(idx))
+                rows = []
+                hashes = []
+                for k, j in enumerate(idx):
+                    consumed_i = max(
+                        int(cols["limit"][j])
+                        - int(cols["remaining"][j]), 0,
+                    )
+                    consumed_f = max(
+                        float(cols["limit"][j])
+                        - float(cols["remaining_f"][j]), 0.0,
+                    )
+                    leaky = int(cols["algo"][j]) == 1
+                    rows.append({
+                        # The RESIDENT row's fields, with the migrated
+                        # consumption folded in.
+                        "algo": int(packed[2][k]),
+                        "limit": int(packed[3][k]),
+                        "duration": int(packed[4][k]),
+                        "remaining": max(
+                            int(packed[5][k])
+                            - (0 if leaky else consumed_i), 0,
+                        ),
+                        "remaining_f": max(
+                            float(rf[k])
+                            - (consumed_f if leaky else 0.0), 0.0,
+                        ),
+                        "t0": int(packed[6][k]),
+                        "status": int(packed[7][k]),
+                        "burst": int(packed[8][k]),
+                        "expire_at": int(packed[9][k]),
+                    })
+                    hashes.append(hashes_u[j])
+                self._bulk_upsert(rows, hashes, now)
+        injected = int(absent.sum())
+        return injected, n - injected
+
     def load_items(self, items) -> int:
         """Bulk upsert CacheItems (Loader restore, workers.go:340-426)."""
         from gubernator_tpu.runtime.store import item_to_row_fields
@@ -612,6 +755,86 @@ class DeviceBackend(PersistenceHost):
             fetch_ravel(self._gather_rows_int_arrays(token)),
             fetch_ravel(self._gather_rows_rf_arrays(token)),
         )
+
+    def migrate_extract_rows(self, fps: np.ndarray):
+        """Fused single-device form of the generic gather-and-clear:
+        each chunk is ONE donated ops/state.migrate_extract dispatch,
+        so extraction and clearing are a per-row atomicity fact (the
+        gubtrace-registered kernel), not a two-step protocol."""
+        from gubernator_tpu.ops.state import migrate_extract
+
+        B = self.cfg.batch_size
+        now = np.int64(self.clock.millisecond_now())
+        packed_devs = []
+        rf_devs = []
+        with self._lock:
+            for lo in range(0, len(fps), B):
+                chunk = np.asarray(fps[lo:lo + B], dtype=np.int64)
+                padded = np.zeros(B, dtype=np.int64)
+                padded[: len(chunk)] = chunk
+                self.table, packed, rf = migrate_extract(
+                    self.table, padded, now, ways=self.cfg.ways
+                )
+                packed_devs.append(packed)
+                rf_devs.append(rf)
+        if not packed_devs:
+            return np.zeros((10, 0), dtype=np.int64), np.zeros(0)
+        ints = fetch_ravel(packed_devs)
+        rfs = fetch_ravel(rf_devs)
+        n = len(fps)
+        return (
+            np.concatenate(ints, axis=1)[:, :n],
+            np.concatenate(rfs)[:n],
+        )
+
+    def migrate_inject_rows(self, cols: Dict[str, np.ndarray]):
+        """Fused single-device inject-if-absent (ops/state
+        .migrate_inject): one donated dispatch per chunk; returns
+        (injected, skipped)."""
+        from gubernator_tpu.ops.state import migrate_inject
+        from gubernator_tpu.ops.step import BucketRows
+
+        B = self.cfg.batch_size
+        now = np.int64(self.clock.millisecond_now())
+        n = len(cols["key_hash"])
+        resident_devs = []
+        actives = []
+        with self._lock:
+            for lo in range(0, len(cols["key_hash"]), B):
+                hi = min(lo + B, n)
+                pad = B - (hi - lo)
+
+                def col(f, dt):
+                    return np.concatenate([
+                        np.asarray(cols[f][lo:hi], dtype=dt),
+                        np.zeros(pad, dtype=dt),
+                    ])
+
+                rows = BucketRows(
+                    key_hash=col("key_hash", np.int64),
+                    algo=col("algo", np.int32),
+                    limit=col("limit", np.int64),
+                    duration=col("duration", np.int64),
+                    remaining=col("remaining", np.int64),
+                    remaining_f=col("remaining_f", np.float64),
+                    t0=col("t0", np.int64),
+                    status=col("status", np.int32),
+                    burst=col("burst", np.int64),
+                    expire_at=col("expire_at", np.int64),
+                )
+                self.table, resident = migrate_inject(
+                    self.table, rows, now, ways=self.cfg.ways
+                )
+                resident_devs.append(resident)
+                actives.append(np.asarray(rows.key_hash) != 0)
+        if not resident_devs:
+            return 0, 0
+        injected = skipped = 0
+        for res, act in zip(fetch_ravel(resident_devs), actives):
+            res = np.asarray(res)
+            injected += int((act & ~res).sum())
+            skipped += int((act & res).sum())
+        return injected, skipped
 
     def warmup(self) -> None:
         """Compile the hot-path executables with a synthetic batch that

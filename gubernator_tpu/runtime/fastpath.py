@@ -657,6 +657,16 @@ class FastPath:
             # (an overload condition; the columnar win is moot).
             self.fallbacks += 1
             return None
+        rs = self.s.reshard
+        if rs is not None and rs.active():
+            # A handoff is in flight on this node (docs/resharding.md):
+            # covered keys must forward-back / serve the bounded shadow
+            # and rerouted keys must leave this table — per-key routing
+            # the object path owns.  The lane steps aside for the
+            # window (seconds per remap); every other daemon keeps its
+            # compiled lane.
+            self.fallbacks += 1
+            return None
         routed = not peer_rpc and not self._single_node()
         if routed and not self._can_route():
             self.fallbacks += 1

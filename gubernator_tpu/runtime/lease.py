@@ -119,6 +119,14 @@ class LeaseManager:
             return "deny-all limit is not leasable"
         if int(req.behavior) & int(NON_LEASABLE):
             return "non-leasable behavior"
+        if not self.s._owns_key(req.hash_key()):
+            # A remap can demote this node between the routing split
+            # and the grant (or a renewal can land on a demoted owner
+            # directly): granting against the stale carve slot here
+            # would be UNBOUNDED over-admission — the slot's budget
+            # no longer backs the authoritative row, which lives (and
+            # is fully spendable) at the new owner.
+            return "not the owner of this key"
         sb = self.s.sketch_backend
         if sb is not None and sb.handles(req):
             return "sketch-tier names are not leasable"
@@ -387,6 +395,40 @@ class LeaseManager:
                 )
         except Exception as e:  # noqa: BLE001 — slots expire anyway
             log.warning("lease slot drop (%s) failed: %s", reason, e)
+
+    # ------------------------------------------------------------------
+    # remap invalidation (runtime/reshard.py; docs/resharding.md)
+    # ------------------------------------------------------------------
+    def on_remap(self) -> None:
+        """The ring changed: spawn the unowned-grant sweep (fire-and-
+        forget on the service loop — set_peers must not await device
+        work)."""
+        self.s.spawn_task(self.drop_unowned())
+
+    async def drop_unowned(self) -> int:
+        """Revoke holder records and drop carve slots for keys this
+        node no longer owns.  A demoted owner keeping them would keep
+        honoring renewals against a stale carve slot — over-admission
+        no algebra bounds, because the new owner grants its own full
+        budget in parallel.  Holders renew through the ring and land on
+        the new owner (their un-burned allowance stays within the lease
+        bound and their burns reconcile there via queue_hit)."""
+        drops: List[RateLimitReq] = []
+        revoked = 0
+        with self._lock:
+            for key in list(self._keys):
+                if self.s._owns_key(key):
+                    continue
+                ks = self._keys.pop(key)
+                revoked += len(ks.holders)
+                if ks.slot_reset is not None:
+                    drops.append(ks.slot_reset)
+        if revoked:
+            self._note_revocation("remap", revoked)
+        if drops:
+            await self._drop_slots(drops, reason="remap")
+        self._refresh_gauge()
+        return revoked
 
     # ------------------------------------------------------------------
     # expiry

@@ -233,6 +233,45 @@ class Metrics:
             registry=r,
         )
 
+        # -- live resharding (runtime/reshard.py; docs/resharding.md) -----
+        self.reshard_state = Gauge(
+            "gubernator_reshard_state",
+            "Per-peer handoff phase (1 prepare, 2 drain, 3 transfer, "
+            "4 cutover, 5 released, 6 aborted); label removed when the "
+            "handoff record expires.",
+            ["peerAddr", "direction"],
+            registry=r,
+        )
+        self.reshard_handoffs = Counter(
+            "gubernator_reshard_handoffs_total",
+            "Completed/aborted/self_cutover handoffs by direction "
+            "(outbound = this node sent rows, inbound = received).",
+            ["direction", "outcome"],
+            registry=r,
+        )
+        self.reshard_rows = Counter(
+            "gubernator_reshard_rows_total",
+            "Migrated table rows by direction: sent, injected, "
+            "skipped (already resident at the receiver), lost "
+            "(undeliverable before the handoff deadline).",
+            ["direction"],
+            registry=r,
+        )
+        self.reshard_window_duration = Histogram(
+            "gubernator_reshard_window_duration",
+            "Outbound handoff window duration in seconds "
+            "(prepare -> cutover acked).",
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.reshard_shadow_served = Counter(
+            "gubernator_reshard_shadow_served_total",
+            "Covered-key checks served from the bounded "
+            ".handoff-shadow carve (handoff_fraction x limit) during "
+            "a handoff window.",
+            registry=r,
+        )
+
         # -- GLOBAL replication (global.go:48-57) -------------------------
         self.async_durations = Histogram(
             "gubernator_async_durations",

@@ -243,6 +243,21 @@ class Service:
                 self, self.cfg.lease, metrics=self.metrics
             )
         self._lease_sweep_task: Optional[asyncio.Task] = None
+        # Elastic membership (runtime/reshard.py; docs/resharding.md):
+        # a remap streams moved rows old owner -> new owner instead of
+        # orphaning them.  None when disabled — a remap then degrades
+        # to the legacy counter reset.
+        self.reshard = None
+        if self.cfg.reshard.enabled:
+            from gubernator_tpu.runtime.reshard import ReshardManager
+
+            self.reshard = ReshardManager(
+                self, self.cfg.reshard, metrics=self.metrics
+            )
+        # The ring as it stood before the latest remap — the inbound
+        # handoff's covered-key test (reshard.inbound_covering).
+        self._prev_picker = None
+        self._reshard_watch_task: Optional[asyncio.Task] = None
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         # On a mesh backend, GLOBAL keys owned by THIS node serve from the
@@ -289,6 +304,10 @@ class Service:
             self._lease_sweep_task = asyncio.ensure_future(
                 self._lease_sweep_loop()
             )
+        if self.reshard is not None:
+            self._reshard_watch_task = asyncio.ensure_future(
+                self._reshard_watch_loop()
+            )
         # Warm the jitted device step so the first client request doesn't
         # pay XLA compilation (20-40s cold) inside an RPC deadline.
         loop = asyncio.get_running_loop()
@@ -334,6 +353,22 @@ class Service:
 
             old_local, old_region = self.local_picker, self.region_picker
             self.local_picker, self.region_picker = local, region
+            self._prev_picker = old_local
+
+        # Live resharding (docs/resharding.md): the remap may have
+        # moved arcs this node owned — stream their rows to the new
+        # owners instead of orphaning them.  Spawned (the delta needs a
+        # device fetch); routing already follows the NEW ring, and the
+        # handoff protocol bounds the window's double admission.
+        if self.reshard is not None and old_local.size() > 0:
+            self.reshard.on_remap(old_local, local)
+        # Derived-slot invalidation: a demoted owner must not keep
+        # honoring lease renewals against a stale carve slot, and a
+        # node that just BECAME a hot key's owner must not keep a
+        # mirror allowance for it.
+        if self.leases is not None and old_local.size() > 0:
+            self.leases.on_remap()
+        self._invalidate_unowned_mirrors()
 
         shutdown: List[PeerClient] = []
         for peer in old_local.peers():
@@ -375,6 +410,119 @@ class Service:
 
     def peer_list(self) -> List[PeerClient]:
         return self.local_picker.peers()
+
+    def _owns_key(self, key: str) -> bool:
+        """Does THIS node own `key` under the current ring?  An empty
+        pool owns everything (single-node mode)."""
+        if self.local_picker.size() == 0:
+            return True
+        try:
+            return self.get_peer(key).info().is_owner
+        except PoolEmptyError:
+            return True
+
+    # ------------------------------------------------------------------
+    # elastic membership (runtime/reshard.py; docs/resharding.md)
+    # ------------------------------------------------------------------
+    def derived_slot_fps(self) -> np.ndarray:
+        """int64 fingerprints of the derived slots this node can
+        invalidate locally — lease carve slots, hot-mirror allowances,
+        degraded shadows, handoff shadows.  The reshard plane excludes
+        them from migration: derived state re-homes by re-creation at
+        its new home (leases re-grant through the ring, mirrors
+        re-promote, shadows re-carve), never by copy."""
+        from gubernator_tpu.core.hashing import key_hash64
+
+        keys: List[str] = []
+        if self.leases is not None:
+            from gubernator_tpu.runtime.lease import LEASE_SUFFIX
+
+            with self.leases._lock:
+                keys.extend(
+                    k + LEASE_SUFFIX for k in self.leases._keys
+                )
+        keys.extend(
+            r.hash_key() for r in self._mirror_resets.values()
+        )
+        for pending in self._shadow.values():
+            keys.extend(pending.keys())
+        if self.reshard is not None:
+            from gubernator_tpu.runtime.reshard import HANDOFF_SUFFIX
+
+            with self.reshard._lock:
+                for ib in self.reshard._inbound.values():
+                    keys.extend(
+                        k + HANDOFF_SUFFIX for k in ib.shadow
+                    )
+        if not keys:
+            return _EMPTY_I64
+        return np.array(
+            [np.uint64(key_hash64(k)).view(np.int64) for k in keys],
+            dtype=np.int64,
+        )
+
+    def _invalidate_unowned_mirrors(self) -> None:
+        """A remap can make this node the OWNER of a key it was
+        mirroring — drop the stale mirror allowance so no widened
+        admission state survives the ownership change."""
+        from gubernator_tpu.runtime.hotkey import MIRROR_SUFFIX
+
+        fps = [
+            fp for fp, r in self._mirror_resets.items()
+            if r.unique_key.endswith(MIRROR_SUFFIX)
+            and self._owns_key(
+                r.name + "_" + r.unique_key[: -len(MIRROR_SUFFIX)]
+            )
+        ]
+        if fps:
+            self._on_hot_demote(fps)
+
+    async def _reshard_watch_loop(self) -> None:
+        """Watchdog cadence for the reshard plane: self-cutover inbound
+        handoffs whose old owner went silent, expire released outbound
+        records past the stale-router linger."""
+        interval = max(self.cfg.reshard.timeout_s / 4.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.reshard.check_timeouts()
+            except Exception as e:  # noqa: BLE001 — keep the cadence
+                log.warning("reshard watchdog failed: %s", e)
+
+    async def handoff(
+        self, from_addr: str, epoch: int, phase: str, total_rows: int
+    ) -> Tuple[bool, str]:
+        """Peer-facing Handoff receive (docs/resharding.md)."""
+        if self.reshard is None:
+            return False, "resharding disabled"
+        return await self.reshard.on_handoff(
+            from_addr, epoch, phase, total_rows
+        )
+
+    async def migrate(
+        self, from_addr: str, epoch: int, rows, final: bool
+    ) -> Tuple[int, int]:
+        """Peer-facing Migrate receive: inject one chunk of packed rows
+        for an active inbound handoff."""
+        if self.reshard is None:
+            raise ApiError(
+                "FAILED_PRECONDITION", "resharding disabled"
+            )
+        try:
+            return await self.reshard.on_migrate(
+                from_addr, epoch, rows, final
+            )
+        except KeyError as e:
+            raise ApiError("FAILED_PRECONDITION", str(e)) from None
+
+    async def drain_for_shutdown(self) -> int:
+        """Graceful scale-down: migrate every owned row to the ring
+        without this node (the autoscaler's SIGTERM/preStop drain),
+        then keep forwarding stale-routed checks until close.  Returns
+        rows shipped; 0 when resharding is disabled or single-node."""
+        if self.reshard is None:
+            return 0
+        return await self.reshard.drain_all()
 
     def _strip_sketch_global(
         self, reqs: Sequence[RateLimitReq]
@@ -754,6 +902,7 @@ class Service:
         local_owner_meta: List[Optional[str]] = []
         forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
         mirrors: List[Tuple[int, PeerClient, RateLimitReq]] = []
+        covered: List[Tuple[int, RateLimitReq, str, object]] = []
 
         reqs = self._strip_sketch_global(reqs)
 
@@ -820,6 +969,25 @@ class Service:
                 )
                 continue
             if peer.info().is_owner:
+                rs = self.reshard
+                if rs is not None and rs.active() and not is_global:
+                    # Live resharding (docs/resharding.md): a key whose
+                    # arc is mid-handoff must not be served from this
+                    # node's (absent or not-yet-authoritative) row.
+                    ib = rs.inbound_covering(key)
+                    if ib is not None:
+                        # We are the NEW owner and the handoff is still
+                        # in flight: forward back / bounded shadow.
+                        covered.append((i, req, key, ib))
+                        continue
+                    tgt = rs.reroute_target(key)
+                    if tgt is not None:
+                        # We are a draining OLD owner whose rows are
+                        # gone: forwards-or-serves says forward.
+                        tp = self.local_picker.get_by_address(tgt)
+                        if tp is not None:
+                            forwards.append((i, tp, req, key))
+                            continue
                 if is_global and self.global_engine is not None:
                     # This node's mesh owns the key: replicated serving +
                     # ICI-collective sync instead of the RPC loops.
@@ -856,6 +1024,12 @@ class Service:
         mirror_tasks = [
             asyncio.ensure_future(self._mirror_serve(req, peer))
             for (_, peer, req) in mirrors
+        ]
+        covered_tasks = [
+            asyncio.ensure_future(
+                self.reshard.serve_covered(req, key, ib)
+            )
+            for (_, req, key, ib) in covered
         ]
 
         try:
@@ -901,6 +1075,18 @@ class Service:
                         responses[i] = RateLimitResp(
                             error=f"Error serving hot-key mirror for "
                             f"'{req.hash_key()}': {resp}"
+                        )
+                    else:
+                        responses[i] = resp
+            if covered_tasks:
+                results = await asyncio.gather(
+                    *covered_tasks, return_exceptions=True
+                )
+                for (i, _, key, _ib), resp in zip(covered, results):
+                    if isinstance(resp, BaseException):
+                        responses[i] = RateLimitResp(
+                            error=f"Error serving resharding key "
+                            f"'{key}': {resp}"
                         )
                     else:
                         responses[i] = resp
@@ -1424,6 +1610,63 @@ class Service:
                     bulk_key_hash64([r.hash_key() for r in valid]),
                     np.array([r.hits for r in valid], dtype=np.int64),
                 )
+        rs = self.reshard
+        if rs is not None and rs.active():
+            # Live resharding (docs/resharding.md): forwarded checks
+            # for mid-handoff keys must not apply on this node's table.
+            # Covered inbound keys (we are the new owner, handoff in
+            # flight) forward back / serve the bounded shadow; rerouted
+            # outbound keys (our rows are gone — post-TRANSFER or a
+            # draining leaver) forward to the new owner.  Everything
+            # else applies locally as usual.
+            special: Dict[int, object] = {}
+            for i, r in enumerate(reqs):
+                if not r.unique_key or not r.name:
+                    continue
+                if has_behavior(r.behavior, Behavior.GLOBAL):
+                    continue
+                key = r.hash_key()
+                ib = rs.inbound_covering(key)
+                if ib is not None:
+                    special[i] = ("covered", key, ib)
+                    continue
+                tgt = rs.reroute_target(key)
+                if tgt is not None:
+                    tp = self.local_picker.get_by_address(tgt)
+                    if tp is not None:
+                        special[i] = ("reroute", key, tp)
+            if special:
+                async def _serve_special(spec, r):
+                    kind, key, arg = spec
+                    if kind == "covered":
+                        return await rs.serve_covered(r, key, arg)
+                    return await self._forward(arg, r, key)
+
+                kept = [
+                    r for i, r in enumerate(reqs) if i not in special
+                ]
+                inner_task = asyncio.gather(*(
+                    _serve_special(special[i], reqs[i])
+                    for i in sorted(special)
+                ), return_exceptions=True)
+                inner = (
+                    await self._check_local(kept) if kept else []
+                )
+                spec_resps = dict(zip(sorted(special), await inner_task))
+                it = iter(inner)
+                out: List[RateLimitResp] = []
+                for i, r in enumerate(reqs):
+                    if i in special:
+                        resp = spec_resps[i]
+                        if isinstance(resp, BaseException):
+                            resp = RateLimitResp(
+                                error="Error serving resharding key "
+                                f"'{r.hash_key()}': {resp}"
+                            )
+                        out.append(resp)
+                    else:
+                        out.append(next(it))
+                return out
         shed = self.shed_level()
         if shed:
             # Owner-side shedding of forwarded traffic — the relief
@@ -1524,6 +1767,11 @@ class Service:
                 f"Pressure shedding active on this node (level {lvl} "
                 f"of {len(self.cfg.hotkey.shed_priorities)})"
             )
+        # Migration-state lines (docs/resharding.md): in-flight
+        # handoffs are advisory — the node IS serving, just with
+        # covered keys routed through the handoff protocol.
+        if self.reshard is not None and self.reshard.active():
+            pressure_lines.extend(self.reshard.health_lines())
         if pressure_lines:
             extra = "|".join(pressure_lines)
             h.message = f"{h.message}|{extra}" if h.message else extra
@@ -1577,6 +1825,12 @@ class Service:
                 self._lease_sweep_task, return_exceptions=True
             )
             self._lease_sweep_task = None
+        if self._reshard_watch_task is not None:
+            self._reshard_watch_task.cancel()
+            await asyncio.gather(
+                self._reshard_watch_task, return_exceptions=True
+            )
+            self._reshard_watch_task = None
         if self._collective_loop is not None:
             await self._collective_loop.close()
         await self.global_mgr.close()

@@ -2,8 +2,9 @@
 cluster (the compressed version of tests/test_chaos.py +
 tests/test_hotkey.py).
 
-Scenarios (--scenario storm|hotkey|lease|all; default storm — the
-original job; CI runs hotkey and lease as their own required steps):
+Scenarios (--scenario storm|hotkey|lease|reshard|all; default storm —
+the original job; CI runs hotkey, lease and reshard as their own
+required steps):
 
   storm   a seeded storm of client/server faults (>=30% of peer RPCs
           fail) with breakers + `local_shadow` degraded mode armed:
@@ -31,6 +32,17 @@ original job; CI runs hotkey and lease as their own required steps):
           owner's row exactly once (queue_hit at-most-once through the
           proxy daemon), and the owner re-collects: released grants
           drop the carve slot.
+
+  reshard membership churn mid-traffic (docs/resharding.md): a JOIN
+          whose Migrate chunks are 100% chaos-failed holds the handoff
+          window open — a fully consumed key admits EXACTLY
+          handoff_fraction x limit more through the new owner's shadow
+          (admitted == limit x (1 + fraction), never one hit over);
+          after heal the transfer completes, post-cutover reads at the
+          new owner bit-match the pymodel continuation (remaining/t0/
+          reset preserved), the old owner's slots are purged (no daemon
+          serves from an orphaned slot), and a graceful LEAVE drains
+          every row back to the survivors with counters conserved.
 
 On any failure each daemon's flight recorder dumps its ring to
 GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
@@ -611,11 +623,225 @@ def lease_scenario(seed: int) -> None:
         cluster.stop()
 
 
+def reshard_scenario(seed: int) -> None:
+    """Membership churn mid-traffic (docs/resharding.md acceptance)."""
+    import time as _t
+
+    from dataclasses import replace
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import (
+        DaemonConfig,
+        ReshardConfig,
+        fast_test_behaviors,
+    )
+    from gubernator_tpu.core.types import RateLimitReq, Status
+    from gubernator_tpu.daemon import Daemon
+    from gubernator_tpu.net.replicated_hash import (
+        ReplicatedConsistentHash,
+        xx_64,
+    )
+    from gubernator_tpu.core.types import PeerInfo
+    from gubernator_tpu.testing import (
+        ChaosInjector,
+        ChaosPlan,
+        Cluster,
+        Rule,
+    )
+    from gubernator_tpu.testing.cluster import TEST_DEVICE
+
+    limit, fraction = 200, 0.25
+    injector = ChaosInjector(ChaosPlan(seed=seed))
+    injector.set_active(False)  # boot runs clean
+    conf = DaemonConfig(
+        reshard=ReshardConfig(
+            handoff_fraction=fraction, timeout_s=30.0,
+            release_linger_s=2.0,
+        ),
+        chaos=injector,
+        flightrec=True,
+        flightrec_dir=os.environ.get(
+            "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+        ),
+    )
+    cluster = Cluster.start_with(["", "", ""], conf_template=conf)
+    try:
+        d0, d1, d2 = cluster.daemons
+
+        # Boot the JOINER first (not yet in any ring) so its address —
+        # and therefore which arcs move — is known up front.
+        async def boot():
+            c = replace(
+                conf,
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                behaviors=fast_test_behaviors(),
+                device=TEST_DEVICE,
+            )
+            d = Daemon(c)
+            await d.start()
+            d.conf.advertise_address = d.grpc_address
+            return d
+
+        d3 = cluster.run(boot(), timeout=300.0)
+
+        class _P:
+            def __init__(self, addr):
+                self._i = PeerInfo(grpc_address=addr)
+
+            def info(self):
+                return self._i
+
+        def owner_addr(key, addrs):
+            pick = ReplicatedConsistentHash(xx_64)
+            for a in addrs:
+                pick.add(_P(a))
+            return pick.get(key).info().grpc_address
+
+        three = [d.grpc_address for d in cluster.daemons]
+        four = three + [d3.grpc_address]
+        movers = [
+            f"r{i}" for i in range(8000)
+            if owner_addr(f"churn_r{i}", three) == d0.grpc_address
+            and owner_addr(f"churn_r{i}", four) == d3.grpc_address
+        ][:2]
+        assert len(movers) == 2, "could not find moving keys"
+        k_sat, k_cons = movers  # saturated key; conservation probe key
+        req_sat = RateLimitReq(name="churn", unique_key=k_sat, hits=1,
+                               limit=limit, duration=DURATION)
+        req_cons = RateLimitReq(name="churn", unique_key=k_cons, hits=1,
+                                limit=limit, duration=DURATION)
+
+        cl = V1Client(d1.grpc_address)
+        try:
+            # Phase 0: saturate k_sat exactly; burn 30 on k_cons.
+            admitted = 0
+            for _ in range(limit + 20):
+                r = cl.get_rate_limits([req_sat], timeout=30)[0]
+                if r.error == "" and r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+            assert admitted == limit, f"saturation {admitted} != {limit}"
+            burned = 30
+            for _ in range(burned):
+                r = cl.get_rate_limits([req_cons], timeout=30)[0]
+                assert r.error == "" and r.status == Status.UNDER_LIMIT
+            pre = d0.service.backend.get_cache_item(f"churn_{k_cons}")
+            assert int(pre.remaining) == limit - burned
+
+            # Phase 1: JOIN with every Migrate chunk chaos-failed —
+            # the handoff window stays open under live traffic.
+            injector.reset(ChaosPlan(seed=seed, rules=[
+                Rule(op="error", where="client", method="Migrate",
+                     probability=1.0, status="UNAVAILABLE",
+                     message="injected: migrate blackhole"),
+            ]))
+            injector.set_active(True)
+            cluster.daemons.append(d3)
+            cluster.run(cluster._push_peers(), timeout=60.0)
+            deadline = _t.monotonic() + 30.0
+            while _t.monotonic() < deadline:
+                ib = d3.service.reshard._inbound.get(d0.grpc_address)
+                if ib is not None and ib.phase == "transfer":
+                    break
+                _t.sleep(0.1)
+            else:
+                raise AssertionError("handoff never reached transfer")
+
+            # The saturated key admits EXACTLY fraction x limit more
+            # through the joiner's bounded shadow — never one hit over.
+            budget = int(limit * fraction)
+            shadow_admitted = 0
+            for _ in range(budget + 30):
+                r = cl.get_rate_limits([req_sat], timeout=30)[0]
+                assert r.error == "", r
+                if r.status == Status.UNDER_LIMIT:
+                    shadow_admitted += 1
+            assert shadow_admitted == budget, (
+                f"shadow admitted {shadow_admitted} != {budget}"
+            )
+            total = admitted + shadow_admitted
+            bound = int(limit * (1 + fraction))
+            assert total == bound, f"admitted {total} != bound {bound}"
+
+            # Phase 2: HEAL — the transfer completes, the shadow burns
+            # reconcile, and the new owner is authoritative.
+            injector.heal()
+            deadline = _t.monotonic() + 30.0
+            while _t.monotonic() < deadline:
+                rs0 = d0.service.reshard
+                if rs0.handoffs_started and rs0.handoffs_started == (
+                    rs0.handoffs_completed + rs0.handoffs_aborted
+                ) and not d3.service.reshard._inbound:
+                    break
+                _t.sleep(0.1)
+            assert d0.service.reshard.handoffs_completed >= 1, (
+                d0.service.reshard.debug_vars()
+            )
+            # No orphaned slots at the demoted owner.
+            assert d0.service.backend.get_cache_item(
+                f"churn_{k_sat}"
+            ) is None
+            assert d0.service.backend.get_cache_item(
+                f"churn_{k_cons}"
+            ) is None
+            # Saturated + reconciled: every further check denies.
+            r = cl.get_rate_limits([req_sat], timeout=30)[0]
+            assert r.status == Status.OVER_LIMIT, r
+            # pymodel continuation on the conserved key: remaining
+            # continues the ORIGINAL window at the new owner.
+            row = d3.service.backend.get_cache_item(f"churn_{k_cons}")
+            assert row is not None
+            assert int(row.remaining) == limit - burned
+            assert row.created_at == pre.created_at
+            r = cl.get_rate_limits([req_cons], timeout=30)[0]
+            assert r.status == Status.UNDER_LIMIT
+            assert int(r.remaining) == limit - burned - 1
+            assert r.reset_time == pre.created_at + DURATION
+
+            # Phase 3: graceful LEAVE — the joiner drains back out;
+            # counters survive the second remap too.
+            shipped = cluster.run(d3.drain(), timeout=60.0)
+            assert shipped >= 2, f"drain shipped {shipped} rows"
+            cluster.daemons.remove(d3)
+            cluster.run(cluster._push_peers(), timeout=60.0)
+            survivor_addr = owner_addr(f"churn_{k_cons}", three)
+            survivor = next(
+                d for d in cluster.daemons
+                if d.grpc_address == survivor_addr
+            )
+            row = survivor.service.backend.get_cache_item(
+                f"churn_{k_cons}"
+            )
+            assert row is not None
+            assert int(row.remaining) == limit - burned - 1
+            r = cl.get_rate_limits([req_cons], timeout=30)[0]
+            assert r.status == Status.UNDER_LIMIT
+            assert int(r.remaining) == limit - burned - 2
+            cluster.run(d3.close(), timeout=60.0)
+
+            print(
+                f"reshard smoke OK: seed={seed} key={k_sat} "
+                f"admitted={total} == bound {bound} exactly, "
+                f"conserved key continued at "
+                f"{limit - burned - 2}/{limit} across join+leave, "
+                f"rows sent={d0.service.reshard.rows_sent}"
+                f"+drain {shipped}, no orphaned slots"
+            )
+        finally:
+            cl.close()
+    except BaseException:
+        _dump_flightrec(cluster, "reshard-smoke-failure")
+        raise
+    finally:
+        cluster.stop()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument(
-        "--scenario", choices=("storm", "hotkey", "lease", "all"),
+        "--scenario",
+        choices=("storm", "hotkey", "lease", "reshard", "all"),
         default="storm",
     )
     args = ap.parse_args()
@@ -625,6 +851,8 @@ def main() -> None:
         hotkey_scenario(args.seed)
     if args.scenario in ("lease", "all"):
         lease_scenario(args.seed)
+    if args.scenario in ("reshard", "all"):
+        reshard_scenario(args.seed)
 
 
 if __name__ == "__main__":

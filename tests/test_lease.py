@@ -257,6 +257,101 @@ def test_grants_refused_while_shedding(svc):
     assert asyncio.run(run(scenario()))
 
 
+def test_remap_drops_unowned_grants(frozen_clock):
+    """ISSUE 11 satellite: a demoted owner must stop honoring grants
+    and renewals against its stale carve slot — on any remap,
+    unowned-key holder records are revoked, the carve slot drops, and
+    a direct grant for an unowned key refuses outright (the renewal
+    path lands here)."""
+    from dataclasses import replace as dc_replace
+
+    from gubernator_tpu.core.config import ReshardConfig
+    from gubernator_tpu.core.types import PeerInfo
+    from gubernator_tpu.net.replicated_hash import (
+        ReplicatedConsistentHash,
+        xx_64,
+    )
+
+    me, other = "10.0.0.1:1051", "10.0.0.2:1051"
+    # Resharding off: this test isolates the LEASE invalidation (the
+    # migration path has its own suite) and must not spawn handoffs
+    # toward unreachable fake peers.
+    s = Service(Config(
+        device=DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        lease=LeaseConfig(
+            fraction=0.25, ttl_ms=60_000, max_holders=2,
+            reconcile_ms=200,
+        ),
+        reshard=ReshardConfig(enabled=False),
+    ), clock=frozen_clock)
+
+    ring2 = ReplicatedConsistentHash(xx_64)
+
+    class _P:
+        def __init__(self, addr):
+            self._i = PeerInfo(grpc_address=addr, is_owner=(addr == me))
+
+        def info(self):
+            return self._i
+
+    for a in (me, other):
+        ring2.add(_P(a))
+    # A key we own under the 2-peer ring but NOT once a third joins.
+    three = ReplicatedConsistentHash(xx_64)
+    for a in (me, other, "10.0.0.3:1051"):
+        three.add(_P(a))
+    key = next(
+        f"m{i}" for i in range(2000)
+        if ring2.get(f"lease_m{i}").info().grpc_address == me
+        and three.get(f"lease_m{i}").info().grpc_address != me
+    )
+
+    async def scenario():
+        await s.start()
+        try:
+            await s.set_peers([
+                PeerInfo(grpc_address=me, is_owner=True),
+                PeerInfo(grpc_address=other),
+            ])
+            lm = s.leases
+            g = (await lm.grant("holder", [_req(key)]))[0]
+            assert g.granted
+            slot_key = f"lease_{key}" + LEASE_SUFFIX
+            assert s.backend.get_cache_item(slot_key) is not None
+            # The remap demotes us for this key.
+            await s.set_peers([
+                PeerInfo(grpc_address=me, is_owner=True),
+                PeerInfo(grpc_address=other),
+                PeerInfo(grpc_address="10.0.0.3:1051"),
+            ])
+            assert not s._owns_key(f"lease_{key}")
+            # A renewal/grant against the demoted owner refuses — no
+            # more admission carved from a slot whose authoritative
+            # row now lives (fully spendable) elsewhere.
+            g2 = (await lm.grant("holder", [_req(key)]))[0]
+            assert not g2.granted and "not the owner" in g2.refusal
+            # The remap sweep revoked the holder and dropped the slot.
+            dropped = await lm.drop_unowned()
+            assert s.backend.get_cache_item(slot_key) is None
+            with lm._lock:
+                assert f"lease_{key}" not in lm._keys
+            # Keys we STILL own are untouched.
+            kept = next(
+                f"m{i}" for i in range(2000)
+                if s._owns_key(f"lease_m{i}")
+            )
+            g3 = (await lm.grant("holder", [_req(kept)]))[0]
+            assert g3.granted
+            assert await lm.drop_unowned() == 0
+            with lm._lock:
+                assert f"lease_{kept}" in lm._keys
+            return dropped
+        finally:
+            await s.close()
+
+    assert asyncio.run(scenario()) >= 0
+
+
 def test_service_lease_disabled():
     s = Service(Config(
         device=DeviceConfig(num_slots=1024, ways=8, batch_size=64),

@@ -1,0 +1,613 @@
+"""Elastic membership: live slot migration (ISSUE 11; docs/resharding.md).
+
+Unit tier: remap-delta computation on pure rings, the migrate
+extract/inject kernels, and the inbound state machine walked with a
+frozen clock (phases, idempotent cutover, stale-epoch rejection,
+watchdog self-cutover).
+
+Cluster tier: a JOIN migrates counters bit-exact (pymodel oracle), the
+handoff window's double admission lands EXACTLY on
+limit x (1 + handoff_fraction) with the window held open, a graceful
+LEAVE drains every row to the survivors, and a discovery watch storm
+coalesces to ONE applied remap.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.core.config import (
+    Config,
+    DaemonConfig,
+    DeviceConfig,
+    ReshardConfig,
+    fast_test_behaviors,
+    reshard_config_from_env,
+)
+from gubernator_tpu.core.types import PeerInfo, RateLimitReq, Status
+from gubernator_tpu.daemon import Daemon
+from gubernator_tpu.net.replicated_hash import ReplicatedConsistentHash, xx_64
+from gubernator_tpu.runtime.reshard import (
+    HANDOFF_SUFFIX,
+    compute_moved,
+)
+from gubernator_tpu.runtime.service import ApiError, Service
+from gubernator_tpu.testing.cluster import TEST_DEVICE, Cluster
+
+LIMIT = 100
+DURATION = 60_000
+
+
+def until_pass(fn, timeout=20.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return fn()
+        except AssertionError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(interval)
+
+
+def _req(key, name="t", hits=1, limit=LIMIT, **kw) -> RateLimitReq:
+    return RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=DURATION, **kw,
+    )
+
+
+class _FakePeer:
+    def __init__(self, addr: str, is_owner: bool = False) -> None:
+        self._info = PeerInfo(grpc_address=addr, is_owner=is_owner)
+
+    def info(self) -> PeerInfo:
+        return self._info
+
+
+def _picker(addrs, me=None) -> ReplicatedConsistentHash:
+    p = ReplicatedConsistentHash(xx_64)
+    for a in addrs:
+        p.add(_FakePeer(a, is_owner=(a == me)))
+    return p
+
+
+def _fp(key: str) -> int:
+    return int(np.uint64(xx_64(key.encode())).view(np.int64))
+
+
+# ---------------------------------------------------------------------
+# knob validation
+# ---------------------------------------------------------------------
+
+def test_reshard_config_validation():
+    with pytest.raises(ValueError, match="handoff_fraction"):
+        ReshardConfig(handoff_fraction=0.0)
+    with pytest.raises(ValueError, match="handoff_fraction"):
+        ReshardConfig(handoff_fraction=1.5)
+    with pytest.raises(ValueError, match="chunk_rows"):
+        ReshardConfig(chunk_rows=0)
+    with pytest.raises(ValueError, match="timeout_s"):
+        ReshardConfig(timeout_s=0)
+
+
+def test_reshard_env_parse_names_env_surface(monkeypatch):
+    monkeypatch.setenv("GUBER_RESHARD_FRACTION", "2.0")
+    with pytest.raises(ValueError, match="GUBER_RESHARD_FRACTION"):
+        reshard_config_from_env()
+    monkeypatch.setenv("GUBER_RESHARD_FRACTION", "0.5")
+    monkeypatch.setenv("GUBER_RESHARD_TIMEOUT", "3s")
+    monkeypatch.setenv("GUBER_RESHARD_CHUNK", "256")
+    cfg = reshard_config_from_env()
+    assert cfg.handoff_fraction == 0.5
+    assert cfg.timeout_s == 3.0
+    assert cfg.chunk_rows == 256
+
+
+# ---------------------------------------------------------------------
+# unit tier: remap delta on pure rings
+# ---------------------------------------------------------------------
+
+def test_compute_moved_delta():
+    me = "10.0.0.1:1051"
+    other = "10.0.0.2:1051"
+    joiner = "10.0.0.3:1051"
+    old = _picker([me, other], me=me)
+    new = _picker([me, other, joiner], me=me)
+    keys = [f"t_k{i}" for i in range(400)]
+    fps = np.array([_fp(k) for k in keys], dtype=np.int64)
+    moved = compute_moved(fps, old, new)
+    # Reference answer straight off the rings, per key.
+    expect = {}
+    for k, fp in zip(keys, fps):
+        if old.get(k).info().grpc_address != me:
+            continue  # we never owned it — nothing to move
+        new_addr = new.get(k).info().grpc_address
+        if new_addr != me:
+            expect.setdefault(new_addr, []).append(int(fp))
+    assert set(moved) == set(expect)
+    for addr in expect:
+        assert sorted(int(f) for f in moved[addr]) == sorted(expect[addr])
+    # The joiner takes SOMETHING from us (400 keys, 3 peers) and never
+    # everything.
+    assert 0 < len(moved.get(joiner, [])) < len(keys)
+    # Identity remap: nothing moves.
+    assert compute_moved(fps, old, _picker([me, other], me=me)) == {}
+    # Empty ring / empty fps: nothing moves, no crash.
+    assert compute_moved(fps[:0], old, new) == {}
+
+
+# ---------------------------------------------------------------------
+# unit tier: the extract/inject kernels through the backend
+# ---------------------------------------------------------------------
+
+def test_backend_extract_clears_and_inject_skips_resident(frozen_clock):
+    from gubernator_tpu.runtime.backend import DeviceBackend
+
+    be = DeviceBackend(
+        DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        clock=frozen_clock,
+    )
+    reqs = [_req(f"k{i}", hits=3) for i in range(10)]
+    be.check(reqs)
+    fps = np.array(
+        [_fp(r.hash_key()) for r in reqs], dtype=np.int64
+    )
+    occ0 = be.occupancy()
+    packed, rf = be.migrate_extract_rows(fps[:6])
+    assert packed.shape == (10, 6)
+    assert (packed[0] != 0).all()  # all found
+    assert (packed[5] == LIMIT - 3).all()  # remaining preserved
+    # Extraction CLEARED the rows — the old owner can never serve a
+    # migrated key from an orphaned slot.
+    assert be.occupancy() == occ0 - 6
+    assert be.get_cache_item(reqs[0].hash_key()) is None
+    # Inject into a second backend: all 6 land, a replay all-skips.
+    be2 = DeviceBackend(
+        DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        clock=frozen_clock,
+    )
+    cols = {
+        "key_hash": fps[:6],
+        "algo": packed[2].astype(np.int32),
+        "limit": packed[3], "duration": packed[4],
+        "remaining": packed[5], "remaining_f": rf,
+        "t0": packed[6], "status": packed[7].astype(np.int32),
+        "burst": packed[8], "expire_at": packed[9],
+    }
+    assert be2.migrate_inject_rows(cols) == (6, 0)
+    item = be2.get_cache_item(reqs[0].hash_key())
+    assert item is not None and int(item.remaining) == LIMIT - 3
+    # Row state is intact on device: consume 1 more hit and check the
+    # continued countdown.
+    resp = be2.check([_req("k0", hits=1)])[0]
+    assert resp.remaining == LIMIT - 4
+    # Conflict MERGE: a backend that already served the key (fresh row,
+    # its own hits) folds the migrated consumption in — total
+    # consumption is the SUM, clamped at the limit (conserved, never
+    # inflated).  (Replay protection is the reshard manager's per-epoch
+    # fingerprint guard, not the kernel's job.)
+    be3 = DeviceBackend(
+        DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        clock=frozen_clock,
+    )
+    be3.check([_req("k0", hits=5), _req("k1", hits=5)])
+    assert be3.migrate_inject_rows(cols) == (4, 2)
+    merged = be3.get_cache_item(reqs[0].hash_key())
+    assert int(merged.remaining) == LIMIT - 5 - 3
+    resp = be3.check([_req("k0", hits=0)])[0]
+    assert resp.remaining == LIMIT - 8
+
+
+def test_mesh_backend_generic_migrate_path(frozen_clock):
+    """The MeshBackend rides the generic PersistenceHost migrate
+    helpers (gather+expire / probe+upsert+merge over the registered
+    sharded kernels) — same contract as the fused single-device
+    kernels: extraction clears, injection lands absent rows exactly
+    and merges resident ones."""
+    from gubernator_tpu.parallel.sharded import MeshBackend
+
+    cfg = DeviceConfig(
+        num_slots=8 * 1024, ways=8, batch_size=64, num_shards=8,
+    )
+    be = MeshBackend(cfg, clock=frozen_clock)
+    reqs = [_req(f"mk{i}", hits=3) for i in range(8)]
+    be.check(reqs)
+    fps = np.array([_fp(r.hash_key()) for r in reqs], dtype=np.int64)
+    packed, rf = be.migrate_extract_rows(fps)
+    assert (packed[0] != 0).all()
+    assert (packed[5] == LIMIT - 3).all()
+    assert be.get_cache_item(reqs[0].hash_key()) is None
+    be2 = MeshBackend(cfg, clock=frozen_clock)
+    be2.check([_req("mk0", hits=5)])  # pre-existing fresh row
+    cols = {
+        "key_hash": fps,
+        "algo": packed[2].astype(np.int32),
+        "limit": packed[3], "duration": packed[4],
+        "remaining": packed[5], "remaining_f": rf,
+        "t0": packed[6], "status": packed[7].astype(np.int32),
+        "burst": packed[8], "expire_at": packed[9],
+    }
+    assert be2.migrate_inject_rows(cols) == (7, 1)
+    # Injected row continues the migrated window…
+    assert int(
+        be2.get_cache_item(reqs[1].hash_key()).remaining
+    ) == LIMIT - 3
+    # …and the conflict merged: 5 local + 3 migrated hits consumed.
+    assert int(
+        be2.get_cache_item(reqs[0].hash_key()).remaining
+    ) == LIMIT - 8
+
+
+# ---------------------------------------------------------------------
+# unit tier: inbound state machine with a frozen clock
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def svc(frozen_clock):
+    s = Service(Config(
+        device=DeviceConfig(num_slots=2048, ways=8, batch_size=64),
+        reshard=ReshardConfig(timeout_s=5.0, release_linger_s=1.0),
+    ), clock=frozen_clock)
+
+    async def run(coro):
+        await s.start()
+        try:
+            return await coro
+        finally:
+            await s.close()
+
+    yield s, run
+
+
+def _rows_pb(reqs, remaining, now):
+    from gubernator_tpu.proto import peers_pb2
+
+    return peers_pb2.MigratedRows(
+        key_hash=[_fp(r.hash_key()) for r in reqs],
+        algo=[0] * len(reqs),
+        limit=[r.limit for r in reqs],
+        duration=[r.duration for r in reqs],
+        remaining=[remaining] * len(reqs),
+        remaining_f=[0.0] * len(reqs),
+        t0=[now] * len(reqs),
+        status=[0] * len(reqs),
+        burst=[0] * len(reqs),
+        expire_at=[now + DURATION] * len(reqs),
+        keys=[r.hash_key() for r in reqs],
+    )
+
+
+def test_inbound_state_machine_walk(svc, frozen_clock):
+    s, run = svc
+    old = "10.9.9.9:1051"
+
+    async def scenario():
+        rs = s.reshard
+        now = frozen_clock.millisecond_now()
+        # PREPARE registers the record and arms the watchdog deadline.
+        assert await s.handoff(old, 7, "prepare", 0) == (True, "prepare")
+        assert rs.active()
+        # Phases other than prepare reject unknown/stale epochs...
+        ok, state = await s.handoff(old, 6, "transfer", 0)
+        assert not ok and "epoch" in state
+        # ...and Migrate for a stale epoch maps to FAILED_PRECONDITION.
+        with pytest.raises(ApiError) as ei:
+            await s.migrate(old, 6, _rows_pb([_req("a")], 50, now), False)
+        assert ei.value.code == "FAILED_PRECONDITION"
+        # TRANSFER, then a chunk injects; a replay skips every row.
+        assert (await s.handoff(old, 7, "transfer", 2))[0]
+        reqs = [_req("a"), _req("b")]
+        assert await s.migrate(
+            old, 7, _rows_pb(reqs, 50, now), False
+        ) == (2, 0)
+        assert await s.migrate(
+            old, 7, _rows_pb(reqs, 50, now), True
+        ) == (0, 2)
+        # Injected rows serve with their migrated remaining.
+        resp = (await s._check_local([_req("a", hits=1)]))[0]
+        assert resp.remaining == 49
+        # CUTOVER finalizes; a repeat is idempotent-accepted.
+        assert (await s.handoff(old, 7, "cutover", 0))[0]
+        assert not rs._inbound
+        assert (await s.handoff(old, 7, "cutover", 0))[0]
+        # Watchdog: a fresh handoff whose sender goes silent
+        # self-cutovers once the frozen clock passes the deadline.
+        assert (await s.handoff(old, 8, "prepare", 0))[0]
+        assert (await s.handoff(old, 8, "transfer", 0))[0]
+        assert await rs.check_timeouts() == 0
+        frozen_clock.advance(6000)
+        assert await rs.check_timeouts() == 1
+        assert not rs._inbound
+        assert rs.self_cutovers == 1
+        return True
+
+    assert asyncio.run(run(scenario()))
+
+
+# ---------------------------------------------------------------------
+# cluster tier
+# ---------------------------------------------------------------------
+
+def _owner_addr(key, addrs):
+    return _picker(addrs).get(key).info().grpc_address
+
+
+def _boot_extra(cluster, conf):
+    """Start one more daemon on the cluster loop WITHOUT pushing the
+    peer set (the joiner, pre-join)."""
+
+    async def boot():
+        c = replace(
+            conf,
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="127.0.0.1:0",
+            behaviors=fast_test_behaviors(),
+            device=TEST_DEVICE,
+        )
+        d = Daemon(c)
+        await d.start()
+        d.conf.advertise_address = d.grpc_address
+        return d
+
+    return cluster.run(boot(), timeout=300.0)
+
+
+def _handoffs_settled(d) -> None:
+    rs = d.service.reshard
+    assert rs.handoffs_started > 0
+    assert rs.handoffs_started == (
+        rs.handoffs_completed + rs.handoffs_aborted
+    )
+
+
+def test_join_migrates_counters_bitmatch():
+    """A JOIN moves a partially consumed key's row to the new owner
+    bit-exact (remaining/t0/expire preserved — the pymodel continuation
+    answers identically), purges the old owner's slot, and later
+    checks continue the same window at the new owner."""
+    conf = DaemonConfig(
+        reshard=ReshardConfig(timeout_s=10.0, release_linger_s=1.0)
+    )
+    cluster = Cluster.start_with(["", ""], conf_template=conf)
+    try:
+        d0, d1 = cluster.daemons
+        d2 = _boot_extra(cluster, conf)
+        two = [d0.grpc_address, d1.grpc_address]
+        three = two + [d2.grpc_address]
+        key = next(
+            f"k{i}" for i in range(5000)
+            if _owner_addr(f"t_k{i}", two) == d0.grpc_address
+            and _owner_addr(f"t_k{i}", three) == d2.grpc_address
+        )
+        hk = f"t_{key}"
+        cl = V1Client(d1.grpc_address)
+        try:
+            burned = 30
+            for _ in range(burned):
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                assert r.status == Status.UNDER_LIMIT and not r.error
+            pre = d0.service.backend.get_cache_item(hk)
+            assert int(pre.remaining) == LIMIT - burned
+
+            cluster.daemons.append(d2)
+            cluster.run(cluster._push_peers(), timeout=60.0)
+            until_pass(lambda: _handoffs_settled(d0))
+            rs0 = d0.service.reshard
+            assert rs0.handoffs_completed >= 1
+            assert rs0.rows_sent >= 1
+
+            # Bit-exact at the new owner; orphaned slot purged.
+            row = d2.service.backend.get_cache_item(hk)
+            assert row is not None
+            assert int(row.remaining) == LIMIT - burned
+            assert row.created_at == pre.created_at
+            assert row.expire_at == pre.expire_at
+            assert d0.service.backend.get_cache_item(hk) is None
+
+            # pymodel oracle: the post-cutover answer is the same
+            # window continuing — one more unit hit reads exactly
+            # limit - burned - 1 with the ORIGINAL reset time.
+            def converged():
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                assert not r.error, r
+                assert r.status == Status.UNDER_LIMIT
+                assert r.metadata.get("owner") == d2.grpc_address
+                return r
+
+            r = until_pass(converged)
+            row2 = d2.service.backend.get_cache_item(hk)
+            assert int(row2.remaining) == int(r.remaining)
+            assert r.reset_time == pre.created_at + DURATION
+        finally:
+            cl.close()
+    finally:
+        cluster.stop()
+
+
+def test_double_admission_bound_exact():
+    """The handoff window held open: a fully consumed key admits
+    EXACTLY handoff_fraction x limit more through the new owner's
+    shadow — never one hit over — and cutover reconciles the burns
+    into the authoritative row (saturated, not inflated)."""
+    fraction = 0.25
+    conf = DaemonConfig(
+        reshard=ReshardConfig(
+            handoff_fraction=fraction, timeout_s=30.0,
+            release_linger_s=1.0,
+        )
+    )
+    cluster = Cluster.start_with(["", ""], conf_template=conf)
+    try:
+        d0, d1 = cluster.daemons
+        d2 = _boot_extra(cluster, conf)
+        two = [d0.grpc_address, d1.grpc_address]
+        three = two + [d2.grpc_address]
+        key = next(
+            f"k{i}" for i in range(5000)
+            if _owner_addr(f"t_k{i}", two) == d0.grpc_address
+            and _owner_addr(f"t_k{i}", three) == d2.grpc_address
+        )
+        hk = f"t_{key}"
+        cl = V1Client(d1.grpc_address)
+        try:
+            # Saturate the authoritative row pre-remap: exactly LIMIT
+            # admitted.
+            admitted = 0
+            for _ in range(LIMIT + 10):
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                if not r.error and r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+            assert admitted == LIMIT
+
+            # Hold the window open: the old owner stops between the
+            # TRANSFER announcement and the extract.
+            gate = cluster.run(_make_event())
+            d0.service.reshard.transfer_gate = gate
+            cluster.daemons.append(d2)
+            cluster.run(cluster._push_peers(), timeout=60.0)
+            until_pass(lambda: _in_transfer(d2, d0.grpc_address))
+
+            # The new owner serves the bounded shadow: EXACTLY
+            # fraction x limit more, tagged, then denies.
+            shadow_budget = int(LIMIT * fraction)
+            shadow_admitted = 0
+            saw_meta = 0
+            for _ in range(shadow_budget + 20):
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                assert not r.error, r
+                if r.metadata.get("reshard") == "handoff-shadow":
+                    saw_meta += 1
+                if r.status == Status.UNDER_LIMIT:
+                    shadow_admitted += 1
+            assert shadow_admitted == shadow_budget
+            assert saw_meta >= shadow_budget
+            assert admitted + shadow_admitted == int(
+                LIMIT * (1 + fraction)
+            )
+
+            # Release the window; the handoff completes and the shadow
+            # reconciles: row saturated at 0, shadow slot dropped, and
+            # every further check denies (no inflation anywhere).
+            cluster.run(_set_event(gate))
+            until_pass(lambda: _handoffs_settled(d0))
+            assert d0.service.reshard.handoffs_completed == 1
+
+            def settled():
+                assert not d2.service.reshard._inbound
+                row = d2.service.backend.get_cache_item(hk)
+                assert row is not None and int(row.remaining) == 0
+                assert d2.service.backend.get_cache_item(
+                    hk + HANDOFF_SUFFIX
+                ) is None
+
+            until_pass(settled)
+            r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+            assert r.status == Status.OVER_LIMIT
+            assert d0.service.backend.get_cache_item(hk) is None
+        finally:
+            cl.close()
+    finally:
+        cluster.stop()
+
+
+async def _make_event():
+    return asyncio.Event()
+
+
+async def _set_event(ev):
+    ev.set()
+
+
+def _in_transfer(d, from_addr):
+    ib = d.service.reshard._inbound.get(from_addr)
+    assert ib is not None and ib.phase == "transfer"
+
+
+def test_leave_drain_conserves_counters():
+    """A graceful LEAVE (drain + remove from the peer set) ships every
+    owned row to the survivors; the leaver forwards stale-routed
+    checks instead of serving from purged slots."""
+    conf = DaemonConfig(
+        reshard=ReshardConfig(timeout_s=10.0, release_linger_s=5.0)
+    )
+    cluster = Cluster.start_with(["", "", ""], conf_template=conf)
+    try:
+        d0, d1, d2 = cluster.daemons
+        key = next(
+            f"k{i}" for i in range(5000)
+            if cluster.owner_daemon_of(f"t_k{i}") is d2
+        )
+        hk = f"t_{key}"
+        cl = V1Client(d0.grpc_address)
+        try:
+            burned = 40
+            for _ in range(burned):
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                assert r.status == Status.UNDER_LIMIT and not r.error
+            pre = d2.service.backend.get_cache_item(hk)
+            assert int(pre.remaining) == LIMIT - burned
+
+            shipped = cluster.run(d2.drain(), timeout=60.0)
+            assert shipped >= 1
+            assert d2.service.backend.get_cache_item(hk) is None
+
+            # Remove the leaver from the survivors' rings.
+            cluster.daemons.remove(d2)
+            cluster.run(cluster._push_peers(), timeout=60.0)
+            survivor = cluster.owner_daemon_of(hk)
+            row = survivor.service.backend.get_cache_item(hk)
+            assert row is not None
+            assert int(row.remaining) == LIMIT - burned
+            assert row.created_at == pre.created_at
+
+            # Live traffic continues the same window at the survivor.
+            r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+            assert r.status == Status.UNDER_LIMIT and not r.error
+            assert int(r.remaining) == LIMIT - burned - 1
+        finally:
+            cl.close()
+        cluster.run(d2.close(), timeout=60.0)
+    finally:
+        cluster.stop()
+
+
+def test_watch_storm_coalesces_to_one_remap():
+    """Satellite: rapid discovery events within GUBER_PEER_DEBOUNCE_MS
+    apply as ONE latest-wins set_peers, through a single serialized
+    applier task that close() can cancel."""
+    cluster = Cluster.start(1)
+    try:
+        d = cluster.daemons[0]
+        d.conf = replace(d.conf, peer_debounce_ms=150)
+
+        async def storm():
+            d._peers_event = asyncio.Event()
+            d._peer_update_task = asyncio.ensure_future(
+                d._apply_peer_updates()
+            )
+            before = d.peer_updates_applied
+            for i in range(8):
+                d._pending_peers = [
+                    PeerInfo(grpc_address=d.grpc_address),
+                    PeerInfo(grpc_address=f"10.0.0.{i}:99"),
+                ]
+                d._peers_event.set()
+                await asyncio.sleep(0.005)
+            await asyncio.sleep(0.6)
+            return before
+
+        before = cluster.run(storm(), timeout=60.0)
+        assert d.peer_updates_applied - before == 1
+        addrs = {p.grpc_address for p in d.peers()}
+        # Latest wins: only the LAST storm event's peer set applied.
+        assert f"10.0.0.7:99" in addrs
+        assert not any(
+            f"10.0.0.{i}:99" in addrs for i in range(7)
+        )
+    finally:
+        cluster.stop()

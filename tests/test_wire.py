@@ -30,6 +30,7 @@ def test_method_paths():
     psvc = peers_pb2.DESCRIPTOR.services_by_name["PeersV1"]
     assert [m.name for m in psvc.methods] == [
         "GetPeerRateLimits", "UpdatePeerGlobals", "Lease", "Reconcile",
+        "Handoff", "Migrate",
     ]
 
 
@@ -62,6 +63,22 @@ def test_field_numbers_match_reference():
     f = peers_pb2.ReconcileItem.DESCRIPTOR.fields_by_name
     assert {k: v.number for k, v in f.items()} == {
         "request": 1, "release": 2, "renew": 3,
+    }
+    # Reshard plane (docs/resharding.md) — a mixed-version cluster must
+    # agree on the migration wire during a rolling upgrade.
+    f = peers_pb2.HandoffReq.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "from_address": 1, "epoch": 2, "phase": 3, "total_rows": 4,
+    }
+    f = peers_pb2.MigratedRows.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "key_hash": 1, "algo": 2, "limit": 3, "duration": 4,
+        "remaining": 5, "remaining_f": 6, "t0": 7, "status": 8,
+        "burst": 9, "expire_at": 10, "keys": 11,
+    }
+    f = peers_pb2.MigrateReq.DESCRIPTOR.fields_by_name
+    assert {k: v.number for k, v in f.items()} == {
+        "from_address": 1, "epoch": 2, "rows": 3, "final": 4,
     }
 
 

@@ -46,6 +46,7 @@ CLASS_LOCK_MAP = {
     ("HotKeyTracker", "_lock"): "hotkey._lock",
     ("LeaseManager", "_lock"): "lease._lock",
     ("_LeaseTable", "_lock"): "lease.client._lock",
+    ("ReshardManager", "_lock"): "reshard._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
@@ -102,6 +103,12 @@ RANK = {
     # (lease.client._lock, client._LeaseTable) has the same contract.
     "lease._lock": 56,
     "lease.client._lock": 57,
+    # reshard._lock (runtime/reshard.py handoff dicts) follows the
+    # lease contract exactly: taken from remap/handoff paths holding
+    # nothing, guards only dict state, never held across an await or
+    # any device work (extraction/injection ride the device executor
+    # outside it).
+    "reshard._lock": 58,
     "flightrec._lock": 60,
     # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
     # flightrec: span bookkeeping may run under ANY layer's lock (a span

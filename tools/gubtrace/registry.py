@@ -39,6 +39,14 @@ reconciles, and carve-slot drops are host/client-side orchestration
 whose device work is ordinary checks through the already-registered
 step entrypoints (the `.lease-grant` slot is a normal table row), so
 the 20 verified kernels and their goldens are unchanged.
+
+The reshard plane (docs/resharding.md) adds TWO kernels in
+ops/state.py: migrate_extract (gather+clear fused — the atomic
+old-owner extraction) and migrate_inject (upsert-if-absent — the
+new-owner injection that can never clobber newer state).  The mesh
+backend's migration path deliberately adds none: it rides the
+registered sharded gather/load kernels through the generic
+PersistenceHost helpers.
 """
 from __future__ import annotations
 
@@ -171,6 +179,43 @@ _APPLY_COUNTERS = _TABLE_COUNTERS + _BATCH_COUNTERS + (".limit",
 # Packed q-form: one widened-int64 row is narrowed back to the int32
 # algo enum (values 0/1 by wire contract).
 _APPLY_Q_CASTS = {"to_f64": 11, "to_i32": 1}
+
+
+def _migrate_spec(name: str, fn_name: str, impl_name: str,
+                  make_rest, counters, allowed_casts,
+                  donated: int) -> KernelSpec:
+    """ops/state.py live-migration kernels (docs/resharding.md): the
+    extract is gather+clear in one donated dispatch (no licensed casts
+    — the only conversions are widenings of the int32 enum columns into
+    the packed int64 stack); the inject is probe+load+merge in one,
+    with ONE licensed to_f64 — the conflict merge's leaky-bucket
+    consumed budget (limit - remaining_f), exact below 2^53 like the
+    step kernels' float sites."""
+
+    def build() -> BuiltKernel:
+        import gubernator_tpu.ops.state as state
+
+        fn = getattr(state, fn_name)
+        impl = functools.partial(getattr(state, impl_name), ways=WAYS)
+
+        def sig(B):
+            return lambda: (_table(), *make_rest(B), _now())
+
+        return BuiltKernel(
+            fn=fn,
+            trace_fn=impl,
+            signatures={f"B{B}": sig(B) for B in (64, 128)},
+            counters=counters,
+            allowed_casts=allowed_casts,
+            perturbations={
+                "weak-now": lambda: (_table(), *make_rest(64), 0),
+            },
+            recompile_budget=3,
+            expect_aliased=donated,
+        )
+
+    return KernelSpec(name=name, where="gubernator_tpu/ops/state.py",
+                      build=build)
 
 
 def _ring_spec() -> KernelSpec:
@@ -571,6 +616,18 @@ def specs() -> List[KernelSpec]:
             lambda B: (np.zeros((12, B), np.int64),),
             _TABLE_COUNTERS + ("[1]", "[2]"),
             dict(_APPLY_Q_CASTS), donated=12,
+        ),
+        # -- ops/state.py: live-migration row kernels -------------------
+        _migrate_spec(
+            "migrate_extract", "migrate_extract", "migrate_extract_impl",
+            lambda B: (np.zeros(B, np.int64),),
+            _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=12,
+        ),
+        _migrate_spec(
+            "migrate_inject", "migrate_inject", "migrate_inject_impl",
+            lambda B: (_bucket_rows(B),),
+            _TABLE_COUNTERS + (".key_hash", ".limit", ".duration", "[2]"),
+            {"to_f64": 1}, donated=12,
         ),
         # -- ops/ring.py: the ring-fed device loop ----------------------
         _ring_spec(),
