@@ -104,7 +104,9 @@ def build_payload(names_keys, hits=1, limit=1_000_000_000, duration=3_600_000,
 
 def bench(seconds: float, concurrency: int,
           depth_sweep: Tuple[int, ...] = (1, 2, 4),
-          serve_sweep: Tuple[str, ...] = ("classic", "pipelined", "ring"),
+          serve_sweep: Tuple[str, ...] = (
+              "classic", "pipelined", "ring", "megaround", "persistent",
+          ),
           workload: str = "",
           mesh_shards: int = 0,
           client_modes: Tuple[str, ...] = ("python", "native", "leased"),
@@ -138,6 +140,8 @@ def bench(seconds: float, concurrency: int,
     from gubernator_tpu.core.config import (
         fastpath_sparse_from_env,
         pipeline_depth_from_env,
+        ring_linger_us_from_env,
+        ring_rounds_from_env,
         ring_slots_from_env,
         serve_mode_from_env,
     )
@@ -146,11 +150,15 @@ def bench(seconds: float, concurrency: int,
     depth = pipeline_depth_from_env()
     serve_mode = serve_mode_from_env()
     ring_slots = ring_slots_from_env()
+    ring_rounds = ring_rounds_from_env()
+    ring_linger = ring_linger_us_from_env()
 
     def conf(**kw) -> DaemonConfig:
         kw.setdefault("pipeline_depth", depth)
         kw.setdefault("serve_mode", serve_mode)
         kw.setdefault("ring_slots", ring_slots)
+        kw.setdefault("ring_rounds", ring_rounds)
+        kw.setdefault("ring_max_linger_us", ring_linger)
         return DaemonConfig(fastpath_sparse=sparse, **kw)
 
     rng = np.random.default_rng(7)
@@ -478,17 +486,36 @@ def bench(seconds: float, concurrency: int,
                 budget["ring_slot_wait_us_per_1000"] = round(
                     rdv["slot_wait_ms_total"] * 1e3 / per_k
                 )
+                # Dispatch-amortization split (docs/ring.md megaround):
+                # the per-ROUND dispatch overhead — the fixed XLA-entry
+                # tax megaround amortizes — plus the running
+                # amortization factor and device dispatches per 1000
+                # served checks.
+                rounds_done = max(rdv["rounds_consumed"], 1)
+                budget["dispatch_us_per_round"] = round(
+                    mach.dispatch_s * 1e6 / rounds_done
+                )
+                budget["rounds_per_dispatch"] = rdv["rounds_per_dispatch"]
+                budget["dispatches_per_1000"] = round(
+                    rdv["iterations"] / per_k, 3
+                )
                 budget["ring"] = rdv
         results.append(budget)
         print(json.dumps(budget), flush=True)
     finally:
         c.stop()
 
-    # ---- serve-mode sweep: classic vs pipelined vs ring ----------------
+    # ---- serve-mode sweep: classic/pipelined/ring/megaround/persistent -
     # Re-run the two throughput configs and the small-batch latency
     # config per drain discipline on fresh single-node daemons; the
-    # acceptance bar is ring-mode blocking_fetches_per_check == 0 with
-    # small-batch p50 at or below the pipelined baseline.
+    # acceptance bars are ring-mode blocking_fetches_per_check == 0 with
+    # small-batch p50 at or below the pipelined baseline, and — under
+    # the dispatch-SATURATION config (many tiny merges at high
+    # concurrency: the workload whose cost IS the per-dispatch tax) —
+    # megaround cutting dispatches-per-check vs plain ring by the
+    # configured round factor (docs/ring.md).  "persistent" is
+    # platform-honest: where the Pallas kernel cannot compile the
+    # stages line reports the megaround fallback and the probe reason.
     for mode in serve_sweep:
         try:
             c = Cluster.start_with(
@@ -542,11 +569,103 @@ def bench(seconds: float, concurrency: int,
                     ),
                 }
                 if fp._ring is not None:
-                    line["ring"] = fp._ring.debug_vars()
+                    rdv = fp._ring.debug_vars()
+                    line["ring"] = rdv
+                    line["rounds_per_dispatch"] = (
+                        rdv["rounds_per_dispatch"]
+                    )
+                    line["dispatches_per_check"] = round(
+                        rdv["iterations"] / max(fp.served, 1), 6
+                    )
+                    line["dispatch_us_per_round"] = round(
+                        mach.dispatch_s * 1e6
+                        / max(rdv["rounds_consumed"], 1)
+                    )
+                if fp.persistent_status is not None:
+                    line["persistent"] = dict(fp.persistent_status)
                 results.append(line)
                 print(json.dumps(line), flush=True)
             finally:
                 c.stop()
+
+            # Dispatch-SATURATION on a DEDICATED small-ring cluster
+            # (ring_slots=2, same for every mode): many tiny merges at
+            # high concurrency make the per-dispatch XLA-entry tax THE
+            # cost, and the deliberately small base tier means plain
+            # ring amortizes at most 2 rounds/dispatch while megaround
+            # may widen to 2 x GUBER_RING_ROUNDS — the ISSUE-12
+            # acceptance comparison (dispatches-per-check reduced by
+            # ~the round factor under saturating load).  The linger is
+            # pinned at 2ms here — the explicit bounded-add-latency
+            # trade this config exists to price — and the ring deltas
+            # are measured across the timed window only (warmup
+            # excluded).
+            c2 = Cluster.start_with(
+                [""], device=dev_cfg,
+                conf_template=conf(serve_mode=mode, ring_slots=2,
+                                   ring_max_linger_us=2000.0),
+            )
+            try:
+                from gubernator_tpu.proto import gubernator_pb2 as pb
+
+                addr2 = [c2.daemons[0].grpc_address]
+                # Duplicate-heavy admission with zero-hit status peeks:
+                # same-key occurrences must observe each other, so the
+                # packer explodes each merge into SEQUENTIAL rounds
+                # (hits=0 peeks break cascade eligibility — the
+                # documented multi-round ring workload, docs/ring.md).
+                # Dispatch count is then round count / block tier, so
+                # the megaround-vs-ring dispatch ratio IS the round
+                # factor once both saturate.
+                dup = [pb.GetRateLimitsReq(requests=[
+                    pb.RateLimitReq(
+                        name="bench_dup", unique_key="hot",
+                        hits=(j % 2), limit=1_000_000_000,
+                        duration=3_600_000,
+                    )
+                    for j in range(10)
+                ]).SerializeToString()]
+                cc = max(concurrency * 4, 32)
+                c2.run(drive(addr2, dup, 0.5, cc), timeout=120)
+                fp2 = c2.daemons[0].fastpath
+                rdv0 = (
+                    fp2._ring.debug_vars()
+                    if fp2._ring is not None else None
+                )
+                t0 = time.perf_counter()
+                rpcs, lat = c2.run(
+                    drive(addr2, dup, sweep_seconds, cc), timeout=120
+                )
+                extra = {
+                    "serve_mode": mode, "concurrency": cc,
+                    "ring_slots": 2, "max_linger_us": 2000,
+                    "effective_serve_mode": (
+                        c2.daemons[0].fastpath.effective_serve_mode
+                    ),
+                }
+                if rdv0 is not None:
+                    rdv1 = fp2._ring.debug_vars()
+                    it = rdv1["iterations"] - rdv0["iterations"]
+                    rc = (
+                        rdv1["rounds_consumed"]
+                        - rdv0["rounds_consumed"]
+                    )
+                    checks = max(rpcs * 10, 1)
+                    extra.update({
+                        "iterations": it,
+                        "rounds_consumed": rc,
+                        "rounds_per_dispatch": round(rc / max(it, 1), 3),
+                        "dispatches_per_check": round(it / checks, 6),
+                        "mega_iterations": (
+                            rdv1["mega_iterations"]
+                            - rdv0["mega_iterations"]
+                        ),
+                        "lingers": rdv1["lingers"] - rdv0["lingers"],
+                    })
+                emit("serve_sweep_dispatch_saturation", rpcs * 10,
+                     rpcs, lat, time.perf_counter() - t0, extra)
+            finally:
+                c2.stop()
         except Exception as e:  # noqa: BLE001 — isolate sweep failures
             print(json.dumps({
                 "config": "serve_sweep", "serve_mode": mode,
@@ -1034,6 +1153,8 @@ def bench(seconds: float, concurrency: int,
         "pipeline_depth_sweep": list(depth_sweep),
         "serve_mode": serve_mode,
         "ring_slots": ring_slots,
+        "ring_rounds": ring_rounds,
+        "ring_max_linger_us": ring_linger,
         "serve_mode_sweep": list(serve_sweep),
         "client_mode_sweep": list(client_modes),
         "mesh_shards": mesh_shards,
@@ -1057,11 +1178,15 @@ def main() -> None:
         "throughput + small-batch configs per depth (empty disables)",
     )
     ap.add_argument(
-        "--serve-mode", default="classic,pipelined,ring",
+        "--serve-mode",
+        default="classic,pipelined,ring,megaround,persistent",
         help="comma-separated GUBER_SERVE_MODE sweep re-running the "
-        "throughput + small-batch configs per drain discipline "
-        "(empty disables); the ring entry reports the fetch-free "
-        "budget split (docs/ring.md)",
+        "throughput + small-batch + dispatch-saturation configs per "
+        "drain discipline (empty disables); ring entries report the "
+        "fetch-free budget split plus the dispatch-amortization "
+        "columns (rounds_per_dispatch, dispatches_per_check, "
+        "dispatch_us_per_round — docs/ring.md), and persistent "
+        "reports its capability probe honestly",
     )
     ap.add_argument(
         "--client-mode", default="python,native,leased",

@@ -423,7 +423,21 @@ def peer_debounce_ms_from_env() -> int:
 #                step, parallel/sharded.make_mesh_ring_step) — ring on
 #                a mesh no longer silently falls back; only a backend
 #                without ring support degrades to pipelined.
-SERVE_MODES = ("classic", "pipelined", "ring")
+#   megaround  — ring plus the adaptive round accumulator: the ring
+#                capacity multiplies to GUBER_RING_SLOTS x
+#                GUBER_RING_ROUNDS and a backlog past the base tier
+#                dispatches as ONE mega scan (ops/ring.mega_ring_step)
+#                — the XLA entry amortized across the whole block,
+#                with add-latency bounded by GUBER_RING_MAX_LINGER_US.
+#                A shallow queue dispatches immediately at base tiers.
+#   persistent — the ring protocol served by the persistent Pallas
+#                decision kernel (ops/pallas/serve_kernel.py): one
+#                kernel LAUNCH drains the whole block with the table
+#                resident across rounds.  TPU-only; capability is
+#                PROBED at arm time and the daemon degrades to
+#                megaround with the reason in /debug/vars where the
+#                kernel cannot compile (docs/ring.md's matrix).
+SERVE_MODES = ("classic", "pipelined", "ring", "megaround", "persistent")
 
 
 def normalize_serve_mode(value: str) -> str:
@@ -632,6 +646,16 @@ class DaemonConfig:
     # Each power-of-two tier up to this costs one XLA compile at
     # warmup.
     ring_slots: int = 8
+    # Megaround multiplier (GUBER_RING_ROUNDS; serve_mode=megaround or
+    # persistent): ring capacity widens to ring_slots x ring_rounds and
+    # a backlog past the base tier dispatches as ONE mega scan — the
+    # XLA entry amortized across the block (docs/ring.md).  1 disables.
+    ring_rounds: int = 4
+    # Adaptive accumulator's bounded add-latency in MICROSECONDS
+    # (GUBER_RING_MAX_LINGER_US): how long the runner may wait for a
+    # mega block to fill once the queue is already past the base tier.
+    # A shallow queue never waits.  0 disables lingering.
+    ring_max_linger_us: float = 200.0
     # Flight recorder / SLO telemetry (runtime/flightrec.py).  Off by
     # default: the ring + sampler are cheap, but dumps write to disk and
     # operators should choose the directory.
@@ -835,6 +859,50 @@ def ring_slots_from_env() -> int:
     return v
 
 
+def ring_rounds_from_env() -> int:
+    """The megaround multiplier (GUBER_RING_ROUNDS): how many base-tier
+    ring rounds one mega dispatch may amortize — capacity becomes
+    GUBER_RING_SLOTS x GUBER_RING_ROUNDS rounds (docs/ring.md).  1
+    disables megaround (the plain ring ladder); past 64 the mega-tier
+    compiles and the scan's padded work outgrow the amortization win —
+    a config mistake, rejected at startup.  The combined
+    slots x rounds capacity is bounded in setup_daemon_config (the two
+    knobs compose)."""
+    v = _require_min(
+        "GUBER_RING_ROUNDS", _env_int("GUBER_RING_ROUNDS", 4), 1
+    )
+    if v > 64:
+        raise ValueError(f"GUBER_RING_ROUNDS must be <= 64, got {v}")
+    return v
+
+
+def ring_linger_us_from_env() -> float:
+    """The megaround accumulator's add-latency bound
+    (GUBER_RING_MAX_LINGER_US, microseconds): how long the runner may
+    wait for a mega block to fill once the queue is already past the
+    base tier.  0 disables lingering (backlog still widens blocks to
+    whatever has queued); past 1s it stops being a linger and starts
+    being an outage — rejected at startup."""
+    raw = _env("GUBER_RING_MAX_LINGER_US", "200")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"GUBER_RING_MAX_LINGER_US must be a number of "
+            f"microseconds, got {raw!r}"
+        ) from None
+    if v < 0:
+        raise ValueError(
+            f"GUBER_RING_MAX_LINGER_US must be >= 0, got {raw!r}"
+        )
+    if v > 1_000_000:
+        raise ValueError(
+            "GUBER_RING_MAX_LINGER_US must be <= 1000000 (1s), got "
+            f"{raw!r}"
+        )
+    return v
+
+
 def mesh_ways_from_env() -> int:
     """The mesh axis size (GUBER_MESH_WAYS — the deployment-mode
     spelling for "shards mapped onto mesh axes"; GUBER_TPU_NUM_SHARDS
@@ -942,6 +1010,14 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
             "GUBER_DEGRADED_SHADOW_FRACTION must be in (0, 1], got "
             f"{shadow_fraction}"
         )
+    ring_rounds = ring_rounds_from_env()
+    if ring_slots_from_env() * ring_rounds > 4096:
+        # The knobs compose: capacity = slots x rounds bounds both the
+        # mega-tier compile ladder and the padded scan's worst case.
+        raise ValueError(
+            "GUBER_RING_SLOTS x GUBER_RING_ROUNDS must be <= 4096, got "
+            f"{ring_slots_from_env()} x {ring_rounds}"
+        )
     return DaemonConfig(
         grpc_listen_address=_env("GUBER_GRPC_ADDRESS", "localhost:1051"),
         http_listen_address=_env("GUBER_HTTP_ADDRESS", "localhost:1050"),
@@ -984,6 +1060,8 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         pipeline_depth=pipeline_depth_from_env(),
         serve_mode=serve_mode_from_env(),
         ring_slots=ring_slots_from_env(),
+        ring_rounds=ring_rounds,
+        ring_max_linger_us=ring_linger_us_from_env(),
         flightrec=_env("GUBER_FLIGHTREC") in ("1", "true"),
         flightrec_dir=_env("GUBER_FLIGHTREC_DIR", "flightrec-dumps"),
         flightrec_ring=_require_min(

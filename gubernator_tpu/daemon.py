@@ -390,6 +390,10 @@ class Daemon:
             pipeline_depth=getattr(self.conf, "pipeline_depth", 2),
             serve_mode=getattr(self.conf, "serve_mode", "pipelined"),
             ring_slots=getattr(self.conf, "ring_slots", 8),
+            ring_rounds=getattr(self.conf, "ring_rounds", 4),
+            ring_max_linger_us=getattr(
+                self.conf, "ring_max_linger_us", 200.0
+            ),
         )
         if self.fastpath._ring is not None:
             # Compile every ring block shape up front — a cold scan

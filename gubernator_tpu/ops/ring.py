@@ -39,6 +39,18 @@ int64[k, 12, n_shards, B] over the sharded grid table and packs a
 PER-SHARD monotone sequence word (int64[n_shards]) alongside the
 responses — so the mesh-ring ≡ single-ring-per-shard equivalence holds
 by construction, not by parallel maintenance of two kernels.
+
+MEGAROUND (GUBER_RING_ROUNDS > 1, docs/ring.md): `mega_ring_step` scans
+up to GUBER_RING_ROUNDS stacked ring rounds — int64[r, s, 12, B], i.e.
+r x s packed rounds — per dispatch, amortizing the per-iteration XLA
+entry + host->device round trip across the whole block.  It is a scan
+OF ring_step_impl (table and seq threaded through the outer carry), so
+the decision semantics are inherited, not duplicated; the adaptive
+round accumulator in runtime/ring.py picks base vs mega tiers per
+block (shallow queue dispatches immediately, a backlog widens to the
+mega tier under a GUBER_RING_MAX_LINGER_US bound).  The mesh lift
+(parallel/sharded.make_mesh_mega_ring_step) composes the same body
+under shard_map, exactly like the base ring.
 """
 from __future__ import annotations
 
@@ -76,6 +88,42 @@ ring_step = jax.jit(
 )
 
 
+def mega_ring_step_impl(
+    table: SlotTable,
+    qs: jax.Array,    # int64[r, s, 12, B] — r stacked ring rounds of s
+    #                   slots each (the megaround block)
+    nows: jax.Array,  # int64[r, s] — per-round clock
+    seq: jax.Array,   # int64[] — the ring sequence word
+    ways: int = 8,
+) -> Tuple[SlotTable, jax.Array, jax.Array]:
+    """Megaround serving: apply `r x s` packed rounds in order with ONE
+    XLA entry — the dispatch-amortization step (GUBER_RING_ROUNDS x
+    GUBER_RING_SLOTS rounds per host->device round trip).  Returns
+    (new_table, int64[r, s, 9, B] packed responses, seq + r*s).
+
+    Structurally a scan OF the ring scan: the outer scan threads the
+    table and the sequence word through `ring_step_impl` — the exact
+    per-slot-tier body the base ring dispatches — so megaround ≡ ring ≡
+    classic holds by construction, not by parallel maintenance of a
+    second decision kernel.  The flattened-round equivalence
+    (mega(qs.reshape(r, s, ...)) == ring(qs[r*s, ...])) is pinned
+    differentially in tests/test_ring.py."""
+
+    def body(carry, qn):
+        tbl, sq = carry
+        q, now = qn
+        tbl, resp, sq = ring_step_impl(tbl, q, now, sq, ways=ways)
+        return (tbl, sq), resp
+
+    (table, seq), resps = jax.lax.scan(body, (table, seq), (qs, nows))
+    return table, resps, seq
+
+
+mega_ring_step = jax.jit(
+    mega_ring_step_impl, static_argnames=("ways",), donate_argnums=(0,)
+)
+
+
 def resolve_ring_tiers(slots: int) -> Tuple[int, ...]:
     """Compiled slot-count tiers for the ring block: powers of two up to
     `slots` (each costs one XLA compile at warmup; a partial block pads
@@ -87,6 +135,18 @@ def resolve_ring_tiers(slots: int) -> Tuple[int, ...]:
         t <<= 1
     tiers.append(slots)
     return tuple(tiers)
+
+
+def resolve_mega_tiers(slots: int, rounds: int) -> Tuple[int, ...]:
+    """Compiled MEGA slot tiers beyond the base ring capacity: `slots x m`
+    total rounds for each ring-round tier m in (1, rounds] — the blocks
+    `mega_ring_step` serves as int64[m, slots, 12, B].  Empty when
+    rounds == 1 (megaround disabled; the base tiers are the whole
+    ladder).  Each costs one XLA compile at warmup, like the base
+    tiers."""
+    return tuple(
+        slots * m for m in resolve_ring_tiers(rounds) if m > 1
+    )
 
 
 def ring_tier_of(k: int, tiers: Tuple[int, ...]) -> int:

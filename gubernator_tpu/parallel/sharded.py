@@ -173,6 +173,39 @@ def make_mesh_ring_step(mesh, ways: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_mesh_mega_ring_step(mesh, ways: int):
+    """Megaround serving on the mesh (docs/ring.md):
+
+        table'[n·S], resps[r, s, n, 9, B], seq'[n] =
+            mesh_mega_ring_step(table[n·S], qs[r, s, 12, n, B],
+                                nows[r, s], seq[n])
+
+    The same composition rule as the base mesh ring: each shard runs
+    ops/ring.mega_ring_step_impl — the EXACT single-table megaround
+    scan-of-scans — on its local [r, s, 12, B] block, so
+    mesh-megaround ≡ one megaround loop per shard by construction.
+    Donation/keep rules are unchanged (table donated, per-shard seq
+    words kept for the double-buffered response protocol), and the hot
+    path still needs NO collectives."""
+    from gubernator_tpu.ops.ring import mega_ring_step_impl
+
+    def _local(table: SlotTable, qs, nows, seq):
+        t2, resps, s2 = mega_ring_step_impl(
+            table, qs[:, :, :, 0, :], nows, seq[0], ways=ways
+        )
+        return t2, resps[:, :, None], s2[None]
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(None, None, None, SHARD_AXIS), P(),
+                  P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(None, None, SHARD_AXIS),
+                   P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def make_sharded_row_op(mesh, ways: int, impl, row_type):
     """Shared factory for row-upsert collectiveless steps: each shard
     applies `impl` to its routed [B] block of `row_type` rows.  Instances:
@@ -300,6 +333,13 @@ class MeshBackend(PersistenceHost):
             self.mesh, P(None, None, SHARD_AXIS)
         )
         self._ring_step = make_mesh_ring_step(self.mesh, cfg.ways)
+        # Megaround request-block sharding: [r, s, 12, n, B] on dim 3.
+        self._mega_qsharding = NamedSharding(
+            self.mesh, P(None, None, None, SHARD_AXIS)
+        )
+        self._mega_ring_step = make_mesh_mega_ring_step(
+            self.mesh, cfg.ways
+        )
         self._cached_store = make_sharded_row_op(
             self.mesh, cfg.ways, store_cached_rows_impl, CachedRows
         )
@@ -357,6 +397,37 @@ class MeshBackend(PersistenceHost):
                 time_mod.monotonic() - t_start
             )
         return resps, seq
+
+    def ring_mega_dispatch(self, qs: np.ndarray, nows: np.ndarray, seq):
+        """Dispatch one MEGAROUND mesh iteration — `qs`
+        int64[r, s, 12, n, B] stacked ring rounds applied in order by
+        the shard_map megaround scan (make_mesh_mega_ring_step) — under
+        the lock.  Returns the un-synced device
+        (responses[r, s, n, 9, B], per-shard seq words); the ring
+        runner flattens the (r, s) round axes back on the host."""
+        import time as time_mod
+
+        t_start = time_mod.monotonic()
+        with self._lock:
+            batch = jax.device_put(qs, self._mega_qsharding)
+            self.table, resps, seq = self._mega_ring_step(
+                self.table, batch, np.asarray(nows, dtype=np.int64), seq
+            )
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time_mod.monotonic() - t_start
+            )
+        return resps, seq
+
+    def persistent_serve_supported(self):
+        """The persistent Pallas decision kernel owns ONE table block;
+        the sharded grid table has no shard_map lift for it yet —
+        honest capability reporting per docs/ring.md: megaround is the
+        mesh's dispatch-amortization tier."""
+        return False, (
+            "persistent serve kernel is single-table only; mesh "
+            "backends serve megaround (the shard_map mega ring step)"
+        )
 
     def _add_tally(self, tally) -> None:
         with self._lock:

@@ -472,6 +472,9 @@ class DeviceBackend(PersistenceHost):
             gather_rows, ways=self.cfg.ways
         )
         self.store = store
+        # Force the persistent serve kernel's interpret emulation
+        # (tests/smokes on CPU; see persistent_serve_supported).
+        self._persistent_interpret = False
         # fingerprint -> hash-key string, maintained when persistence needs
         # to reconstruct key strings from device rows (save path).
         self._keymap: Optional[Dict[int, str]] = (
@@ -678,6 +681,75 @@ class DeviceBackend(PersistenceHost):
             self.table, resps, seq = ring_step(
                 self.table, qs, nows, seq, ways=self.cfg.ways
             )
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
+        return resps, seq
+
+    def ring_mega_dispatch(self, qs: np.ndarray, nows: np.ndarray, seq):
+        """Dispatch one MEGAROUND iteration — `qs` int64[r, s, 12, B]
+        stacked ring rounds applied in order by ops/ring.mega_ring_step
+        (ONE XLA entry for r*s rounds; docs/ring.md's
+        dispatch-amortization tier) — under the lock.  Returns the
+        un-synced device (responses[r, s, 9, B], new seq word); the
+        ring runner flattens the (r, s) round axes back on the host."""
+        from gubernator_tpu.ops.ring import mega_ring_step
+
+        t_start = time.monotonic()
+        with self._lock:
+            self.table, resps, seq = mega_ring_step(
+                self.table, qs, nows, seq, ways=self.cfg.ways
+            )
+        if self.metrics is not None:
+            self.metrics.device_step_duration.observe(
+                time.monotonic() - t_start
+            )
+        return resps, seq
+
+    # -- persistent serve kernel (ops/pallas/serve_kernel.py) ------------
+    def persistent_serve_supported(self):
+        """(ok, reason) capability report for GUBER_SERVE_MODE=
+        persistent: a real probe compile on this backend's platform
+        (docs/ring.md's capability matrix), or the forced interpret
+        mode tests/smokes use to exercise the persistent serving path
+        on CPU.  The runtime falls back to megaround when not ok and
+        surfaces the reason in /debug/vars."""
+        if self._persistent_interpret:
+            return True, (
+                "interpret mode forced (CPU emulation; differential "
+                "tests/smokes only — not a performance mode)"
+            )
+        from gubernator_tpu.ops.pallas.serve_kernel import (
+            persistent_supported,
+        )
+
+        return persistent_supported(self._device.platform)
+
+    def persistent_serve_dispatch(
+        self, qs: np.ndarray, nows: np.ndarray, seq
+    ):
+        """Dispatch one persistent-kernel iteration — `qs`
+        int64[k, 12, B] stacked rounds drained inside ONE Pallas launch
+        — under the lock.  Same contract as ring_step_dispatch; the
+        interpret form runs the un-jitted emulation (exact, slow — the
+        differential path, never a deployment mode)."""
+        from gubernator_tpu.ops.pallas.serve_kernel import (
+            persistent_serve_step,
+            persistent_serve_step_impl,
+        )
+
+        t_start = time.monotonic()
+        with self._lock:
+            if self._persistent_interpret:
+                self.table, resps, seq = persistent_serve_step_impl(
+                    self.table, qs, nows, seq, ways=self.cfg.ways,
+                    interpret=True,
+                )
+            else:
+                self.table, resps, seq = persistent_serve_step(
+                    self.table, qs, nows, seq, ways=self.cfg.ways
+                )
         if self.metrics is not None:
             self.metrics.device_step_duration.observe(
                 time.monotonic() - t_start

@@ -472,7 +472,9 @@ class FastPath:
                  sparse_limit: int = 64,
                  pipeline_depth: int = 2,
                  serve_mode: str = "pipelined",
-                 ring_slots: int = 8) -> None:
+                 ring_slots: int = 8,
+                 ring_rounds: int = 4,
+                 ring_max_linger_us: float = 200.0) -> None:
         from gubernator_tpu.core.config import normalize_serve_mode
 
         if max_inflight < 1:
@@ -490,25 +492,59 @@ class FastPath:
         # Drain discipline (docs/ring.md): classic = strict depth-1,
         # pipelined = depth-k fetch overlap, ring = the device-resident
         # serving loop (runtime/ring.py) with NO blocking fetch on the
-        # request path.  Single-table AND mesh backends both serve ring
-        # mode; only a backend without ring support degrades to
-        # pipelined (docs/ring.md's fallback rule — no longer the mesh).
+        # request path, megaround = ring plus the adaptive round
+        # accumulator (dispatch amortized across up to
+        # ring_slots x ring_rounds rounds), persistent = the ring
+        # protocol served by the persistent Pallas decision kernel.
+        # Single-table AND mesh backends serve ring/megaround; only a
+        # backend without ring support degrades to pipelined, and
+        # persistent degrades to megaround wherever the kernel cannot
+        # compile — with the probe's reason kept for /debug/vars
+        # (docs/ring.md's capability matrix).
         self.serve_mode = serve_mode  # requested
         self._ring = None
+        self.persistent_status = None
         if serve_mode == "classic":
             pipeline_depth = 1
-        elif serve_mode == "ring":
-            if getattr(service.backend, "ring_supported",
-                       lambda: False)():
+        elif serve_mode in ("ring", "megaround", "persistent"):
+            backend = service.backend
+            persistent = False
+            if serve_mode == "persistent":
+                ok, reason = getattr(
+                    backend, "persistent_serve_supported",
+                    lambda: (
+                        False, "backend has no persistent serve kernel"
+                    ),
+                )()
+                self.persistent_status = {
+                    "supported": bool(ok), "reason": reason,
+                }
+                if ok:
+                    persistent = True
+                else:
+                    # Honest fallback: megaround is the next-best
+                    # dispatch-amortization tier, everywhere.
+                    serve_mode = "megaround"
+            rounds = 1 if serve_mode == "ring" else max(ring_rounds, 1)
+            if getattr(backend, "ring_supported", lambda: False)():
                 from gubernator_tpu.runtime.ring import RingBackend
 
                 self._ring = RingBackend(
-                    service.backend, slots=ring_slots, metrics=metrics
+                    backend, slots=ring_slots, metrics=metrics,
+                    rounds=rounds,
+                    max_linger_us=(
+                        ring_max_linger_us if rounds > 1 else 0.0
+                    ),
+                    persistent=persistent,
                 )
                 # The coalescer's fetch stage in ring mode only waits on
                 # a published slot (cheap), so let enough merges be
-                # outstanding to keep the ring runner fed.
-                pipeline_depth = max(pipeline_depth, min(ring_slots, 4))
+                # outstanding to keep the ring runner fed — and in
+                # megaround mode, enough to let a backlog actually form
+                # past the base tier (the accumulator's load signal).
+                pipeline_depth = max(
+                    pipeline_depth, min(ring_slots * rounds, 8)
+                )
             else:
                 serve_mode = "pipelined"  # docs/ring.md fallback rule
         self.effective_serve_mode = serve_mode
@@ -586,6 +622,11 @@ class FastPath:
         }
         if self._ring is not None:
             out["ring"] = self._ring.debug_vars()
+        if self.persistent_status is not None:
+            # Honest capability reporting for GUBER_SERVE_MODE=
+            # persistent: whether the Pallas serve kernel armed, and
+            # the probe's reason when it degraded to megaround.
+            out["persistent"] = dict(self.persistent_status)
         return out
 
     def _ring_live(self):

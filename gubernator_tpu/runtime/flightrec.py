@@ -159,13 +159,21 @@ class FlightRecorder:
         errors: int = 0,
         peer: str = "",
         kind: str = "device_step",
+        rounds_per_dispatch: float = None,
     ) -> None:
         """One device step / peer batch: the ISSUE's record shape
-        (batch size, outcome mix, peer, step wall time)."""
+        (batch size, outcome mix, peer, step wall time).  Ring records
+        (kind="ring_iter") carry the running dispatch-amortization
+        factor so a breach dump shows whether megaround was actually
+        amortizing when the tail spiked (docs/ring.md)."""
         self.record(
             kind, size=int(size), step_ms=round(step_ms, 3),
             over_limit=int(over_limit), errors=int(errors),
             **({"peer": peer} if peer else {}),
+            **(
+                {"rounds_per_dispatch": float(rounds_per_dispatch)}
+                if rounds_per_dispatch is not None else {}
+            ),
         )
 
     def record_bubble(self, lane: str, wait_ms: float) -> None:

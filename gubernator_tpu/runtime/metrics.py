@@ -427,6 +427,15 @@ class Metrics:
             "queues mean the runner is stuck on a host job or fetch).",
             registry=r,
         )
+        self.ring_rounds_per_dispatch = Gauge(
+            "gubernator_ring_rounds_per_dispatch",
+            "Running dispatch-amortization factor: real (un-padded) "
+            "rounds served per device dispatch since the ring armed.  "
+            "Megaround serving (GUBER_RING_ROUNDS > 1) exists to raise "
+            "this under load; ~1.0 under saturating traffic means every "
+            "round still pays its own XLA entry (docs/ring.md).",
+            registry=r,
+        )
 
         # -- TPU-specific -------------------------------------------------
         self.device_step_duration = Histogram(

@@ -44,6 +44,23 @@ whose per-shard sequence words all advance by the consumed tier and are
 verified against the host mirror element-wise).  Blocks stack rounds
 along the leading slot axis either way.
 
+MEGAROUND (GUBER_RING_ROUNDS > 1; docs/ring.md): the ring capacity
+multiplies to slots x rounds and the runner becomes an ADAPTIVE ROUND
+ACCUMULATOR — a shallow queue (<= the base slot tier) dispatches
+immediately exactly as before, but a backlog past the base tier widens
+the block to the mega tiers (ops/ring.mega_ring_step: ONE XLA entry for
+up to slots x rounds rounds), lingering at most GUBER_RING_MAX_LINGER_US
+for the block to fill.  Every other contract — double buffering, the
+sequence word, mixed-tier response slicing, FIFO host jobs, the
+broken-ring fallback — is tier-agnostic and unchanged.
+
+PERSISTENT (GUBER_SERVE_MODE=persistent): blocks route through the
+backend's persistent Pallas serve kernel (ops/pallas/serve_kernel.py —
+one kernel LAUNCH drains the whole block with the table resident across
+rounds) instead of the scans; the caller gates on
+`persistent_serve_supported()` and falls back to megaround where the
+kernel cannot compile (honest capability reporting, docs/ring.md).
+
 On TPU backends with Pallas DMA support the same protocol maps onto a
 device-resident loop with host-pinned rings (docs/ring.md); this runner
 is the portable host-driven form and the semantic reference for it.
@@ -57,7 +74,11 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from gubernator_tpu.ops.ring import resolve_ring_tiers, ring_tier_of
+from gubernator_tpu.ops.ring import (
+    resolve_mega_tiers,
+    resolve_ring_tiers,
+    ring_tier_of,
+)
 from gubernator_tpu.runtime import tracing
 from gubernator_tpu.runtime.tracing import device_step_annotation
 
@@ -138,17 +159,49 @@ class RingBackend:
     def __init__(
         self, backend, slots: int = 8, metrics=None,
         job_timeout_s: float = JOB_TIMEOUT_S,
+        rounds: int = 1, max_linger_us: float = 0.0,
+        persistent: bool = False,
     ) -> None:
         if slots < 1:
             raise ValueError(f"ring slots must be >= 1, got {slots}")
+        if rounds < 1:
+            raise ValueError(f"ring rounds must be >= 1, got {rounds}")
+        if max_linger_us < 0:
+            raise ValueError(
+                f"ring max_linger_us must be >= 0, got {max_linger_us}"
+            )
         if not getattr(backend, "ring_supported", lambda: False)():
             raise ValueError(
                 f"{type(backend).__name__} does not support the ring "
                 "drain discipline"
             )
+        if persistent and not hasattr(
+            backend, "persistent_serve_dispatch"
+        ):
+            raise ValueError(
+                f"{type(backend).__name__} has no persistent serve "
+                "dispatch (caller must gate on "
+                "persistent_serve_supported())"
+            )
         self._backend = backend
         self.slots = slots
+        # Megaround serving (docs/ring.md): `rounds` multiplies the
+        # ring capacity to slots x rounds and arms mega dispatch tiers
+        # — ONE XLA entry per up-to-capacity block.  The adaptive
+        # accumulator (_maybe_linger_locked + _take_block_locked)
+        # dispatches base tiers immediately while the queue is shallow
+        # and widens to the mega tiers only under backlog, lingering at
+        # most max_linger_us for the block to fill.
+        self.rounds = rounds
+        self.capacity = slots * rounds
+        self.max_linger_s = max_linger_us * 1e-6
+        # persistent: route every block through the backend's
+        # persistent Pallas serve kernel instead of the ring/mega scans
+        # (GUBER_SERVE_MODE=persistent; the caller verified capability).
+        self.persistent = persistent
         self._tiers = resolve_ring_tiers(slots)
+        self._mega_tiers = resolve_mega_tiers(slots, rounds)
+        self._all_tiers = self._tiers + self._mega_tiers
         self._metrics = metrics
         self._cond = threading.Condition()
         self._queue: deque = deque()
@@ -178,6 +231,12 @@ class RingBackend:
         self.slot_waits = 0
         self.loop_lag_s = 0.0  # latest gap between consecutive dispatches
         self.max_block = 0
+        # Megaround accounting: iterations served at a mega tier, and
+        # the adaptive accumulator's linger waits (count + total time —
+        # every wait is bounded by max_linger_us).
+        self.mega_iterations = 0
+        self.lingers = 0
+        self.linger_s = 0.0
         self._last_dispatch = None
         self._seq_dev = backend.ring_seq_init()
         self._runner = threading.Thread(
@@ -231,12 +290,14 @@ class RingBackend:
         n = int(qs.shape[0])
         if n == 0:
             return lambda: []
-        if n > self.slots:
-            n_chunks = -(-n // self.slots)
+        if n > self.capacity:
+            n_chunks = -(-n // self.capacity)
             waits = []
-            for lo in range(0, n, self.slots):
+            for lo in range(0, n, self.capacity):
                 try:
-                    waits.append(self._submit_chunk(qs[lo:lo + self.slots]))
+                    waits.append(
+                        self._submit_chunk(qs[lo:lo + self.capacity])
+                    )
                 except RingClosedError as e:
                     if not waits:
                         raise
@@ -263,7 +324,7 @@ class RingBackend:
         waited = False
         with self._cond:
             while (
-                self._pending_rounds + n > self.slots
+                self._pending_rounds + n > self.capacity
                 and not self._closed
                 and not self.broken
             ):
@@ -301,65 +362,142 @@ class RingBackend:
             self._cond.notify_all()
         return job.wait
 
+    def rounds_per_dispatch(self) -> float:
+        """The dispatch-amortization factor: real (un-padded) rounds
+        served per device dispatch — the number megaround exists to
+        raise (gubernator_ring_rounds_per_dispatch; docs/ring.md)."""
+        return self.rounds_consumed / max(self.iterations, 1)
+
     def debug_vars(self) -> dict:
         return {
             "slots": self.slots,
+            "rounds": self.rounds,
+            "capacity": self.capacity,
+            "max_linger_us": round(self.max_linger_s * 1e6, 1),
+            "persistent": self.persistent,
             "seq": self.seq,
             "seq_shards": list(self.seq_shards),
             "seq_mismatches": self.seq_mismatches,
             "iterations": self.iterations,
+            "mega_iterations": self.mega_iterations,
             "rounds_consumed": self.rounds_consumed,
+            "rounds_per_dispatch": round(self.rounds_per_dispatch(), 3),
             "padded_rounds": self.padded_rounds,
             "host_jobs": self.host_jobs,
             "slot_waits": self.slot_waits,
             "slot_wait_ms_total": round(self.slot_wait_s * 1e3, 3),
+            "lingers": self.lingers,
+            "linger_ms_total": round(self.linger_s * 1e3, 3),
             "loop_lag_ms": round(self.loop_lag_s * 1e3, 3),
             "max_block": self.max_block,
             "broken": self.broken,
         }
 
     def warmup(self) -> None:
-        """Compile every (slot tier x batch tier) ring block shape so no
-        client merge pays a cold XLA compile mid-serving (the daemon
-        calls this after arming the ring; a cold scan compile inside a
-        request's ring iteration would show up as a multi-second p99
-        spike).  All-zero blocks are inactive no-ops — the table is
-        untouched, only the sequence word advances."""
+        """Compile every (slot tier x batch tier) ring block shape —
+        mega tiers included — so no client merge pays a cold XLA
+        compile mid-serving (the daemon calls this after arming the
+        ring; a cold scan compile inside a request's ring iteration
+        would show up as a multi-second p99 spike).  All-zero blocks
+        are inactive no-ops — the table is untouched, only the sequence
+        word advances."""
         resps = None
         for tb in self._backend._tiers:
-            for t in self._tiers:
+            for t in self._all_tiers:
                 qs = np.zeros(
                     (t,) + tuple(self._backend.ring_q_shape(tb)),
                     dtype=np.int64,
                 )
                 nows = np.zeros(t, dtype=np.int64)
-                resps, self._seq_dev = self._backend.ring_step_dispatch(
-                    qs, nows, self._seq_dev
-                )
+                resps, _mega = self._dispatch_raw(qs, nows)
                 self.seq += t
         if resps is not None:
             np.asarray(resps)  # sync the last warmup block
 
     # -- runner side ------------------------------------------------------
+    def _maybe_linger_locked(self) -> None:
+        """The adaptive round accumulator's bounded wait (megaround
+        only): a SHALLOW queue (<= the base slot capacity) dispatches
+        immediately — megaround must never add latency to light
+        traffic — but a backlog already past the base tier is the
+        under-load signal, so the runner lingers up to max_linger_us
+        for the mega block to fill toward capacity before dispatching.
+        Caller holds `_cond`; producers' notify_all wakes the wait as
+        rounds arrive."""
+        if self.rounds <= 1 or self.max_linger_s <= 0.0:
+            return
+        if not self._queue or self._queue[0].fn is not None:
+            return
+        if self._pending_rounds <= self.slots:
+            return  # shallow: dispatch now
+        if self._pending_rounds >= self.capacity:
+            return  # already full: nothing to wait for
+        t0 = time.monotonic()
+        deadline = t0 + self.max_linger_s
+        while (
+            self._pending_rounds < self.capacity
+            and not self._closed
+            and not self.broken
+        ):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._cond.wait(timeout=remaining)
+        self.lingers += 1
+        self.linger_s += time.monotonic() - t0
+
     def _take_block_locked(self) -> Optional[List[_Job]]:
         """Pop the next FIFO unit: a host job alone, or every queued
-        rounds-job up to the slot capacity as one block.  Caller holds
-        `_cond`."""
+        rounds-job up to the adaptive capacity as one block — the base
+        slot tier while the queue is shallow, the mega capacity
+        (slots x rounds) once the backlog is past the base tier (the
+        under-load half of the accumulator).  Caller holds `_cond`."""
         if not self._queue:
             return None
         if self._queue[0].fn is not None:
             return [self._queue.popleft()]
+        cap = (
+            self.capacity if self._pending_rounds > self.slots
+            else self.slots
+        )
         block: List[_Job] = []
         taken = 0
         while self._queue and self._queue[0].fn is None:
             n = int(self._queue[0].qs.shape[0])
-            if block and taken + n > self.slots:
+            if block and taken + n > cap:
                 break
             block.append(self._queue.popleft())
             taken += n
         self._pending_rounds -= taken
         self._cond.notify_all()  # wake producers blocked on capacity
         return block
+
+    def _dispatch_raw(self, qs: np.ndarray, nows: np.ndarray):
+        """Route one padded [tier, ...] block to the armed decision
+        kernel: the persistent Pallas serve kernel when armed, the
+        megaround scan for tiers past the base slot capacity, the base
+        ring scan otherwise.  Returns (device responses, mega flag —
+        True when the responses carry a leading (r, s) round grid the
+        fetch must flatten)."""
+        be = self._backend
+        tier = int(qs.shape[0])
+        if self.persistent:
+            resps, self._seq_dev = be.persistent_serve_dispatch(
+                qs, nows, self._seq_dev
+            )
+            return resps, False
+        if tier > self.slots:
+            r = tier // self.slots
+            resps, self._seq_dev = be.ring_mega_dispatch(
+                qs.reshape((r, self.slots) + qs.shape[1:]),
+                nows.reshape(r, self.slots),
+                self._seq_dev,
+            )
+            return resps, True
+        resps, self._seq_dev = be.ring_step_dispatch(
+            qs, nows, self._seq_dev
+        )
+        return resps, False
 
     def _dispatch_block(self, block: List[_Job]):
         """Assemble a jobs-block into one [tier, 12, B] request-ring
@@ -369,7 +507,7 @@ class RingBackend:
         seq, t0)."""
         be = self._backend
         k = sum(int(job.qs.shape[0]) for job in block)
-        tier = ring_tier_of(k, self._tiers)
+        tier = ring_tier_of(k, self._all_tiers)
         # Slot layout is backend-defined (ring_q_shape): [12, B] single
         # table, [12, n, B] mesh grid.  The inner dims are constant
         # across jobs; only the trailing batch tier varies.
@@ -412,11 +550,11 @@ class RingBackend:
         # ring loop-lag gauges line up with the device timeline.
         with tracing.use_context(isp.context if isp is not None else None):
             with device_step_annotation("gubernator_ring_step"):
-                resps, seq_out = be.ring_step_dispatch(
-                    qs, nows, self._seq_dev
-                )
-        self._seq_dev = seq_out
+                resps, mega = self._dispatch_raw(qs, nows)
+        seq_out = self._seq_dev
         self.iterations += 1
+        if mega or (self.persistent and tier > self.slots):
+            self.mega_iterations += 1
         self.rounds_consumed += k
         self.padded_rounds += tier - k
         self.seq += tier
@@ -430,10 +568,11 @@ class RingBackend:
         m = self._metrics
         if m is not None:
             m.fastpath_ring_occupancy.observe(k)
+            m.ring_rounds_per_dispatch.set(self.rounds_per_dispatch())
         # seq_out rides the token so the fetch reads THIS iteration's
         # device word even after the next iteration dispatches with it.
         return (
-            block, resps, seq_out, self.seq, t0,
+            block, resps, seq_out, self.seq, t0, mega,
             isp.context if isp is not None else None,
         )
 
@@ -441,7 +580,7 @@ class RingBackend:
         """The response-ring side: ONE packed transfer for the whole
         iteration (responses + sequence word), then per-job publication.
         Runs only on the runner thread — never on the request path."""
-        block, resps, seq_dev, want_seq, t0, it_ctx = token
+        block, resps, seq_dev, want_seq, t0, mega, it_ctx = token
         fsp = tracing.start_span(
             "ring.fetch_publish", it_ctx, **{"ring.seq": want_seq}
         )
@@ -450,13 +589,13 @@ class RingBackend:
                 fsp.context if fsp is not None else it_ctx
             ):
                 self._fetch_publish_inner(block, resps, seq_dev,
-                                          want_seq, t0)
+                                          want_seq, t0, mega)
         finally:
             if fsp is not None:
                 fsp.end()
 
     def _fetch_publish_inner(
-        self, block, resps, seq_dev, want_seq, t0
+        self, block, resps, seq_dev, want_seq, t0, mega=False
     ) -> None:
         from gubernator_tpu.runtime.backend import (
             _packed_resp_dict,
@@ -470,6 +609,11 @@ class RingBackend:
             for job in block:
                 job.publish(error=e)
             return
+        if mega:
+            # Mega blocks dispatch as an [r, s, ...] round grid
+            # (mega_ring_step); flatten the two round axes back so
+            # per-job slicing below is tier-agnostic.
+            host = host.reshape((-1,) + host.shape[2:])
         # Scalar word on a single-table backend; int64[n] per-shard
         # words on the mesh — EVERY shard's word must agree with the
         # host mirror (a lagging shard means its loop dropped or
@@ -500,7 +644,8 @@ class RingBackend:
         fr = getattr(m, "flightrec", None) if m is not None else None
         if fr is not None:
             fr.record_batch(
-                off, (time.monotonic() - t0) * 1e3, kind="ring_iter"
+                off, (time.monotonic() - t0) * 1e3, kind="ring_iter",
+                rounds_per_dispatch=round(self.rounds_per_dispatch(), 3),
             )
 
     def _mark_broken(self) -> None:
@@ -520,6 +665,7 @@ class RingBackend:
                     self._cond.wait()
                 if self._closed and not self._queue and inflight is None:
                     return
+                self._maybe_linger_locked()
                 unit = self._take_block_locked()
                 dead = self._closed or self.broken
                 dead_msg = "ring closed" if self._closed else "ring broken"

@@ -509,7 +509,8 @@ def test_ring_mode_differential(frozen_clock):
                 Config(device=dev, store=store), clock=frozen_clock
             )
             await svc.start()
-            fp = FastPath(svc, serve_mode=mode, ring_slots=4)
+            fp = FastPath(svc, serve_mode=mode, ring_slots=4,
+                          ring_rounds=2, ring_max_linger_us=2000.0)
             results: dict = {}
 
             async def worker(w: int):
@@ -556,3 +557,21 @@ def test_ring_mode_differential(frozen_clock):
     assert base_dv["blocking_fetches"]["mach"] > 0
     assert ring_dv["ring"]["iterations"] + ring_dv["ring"]["host_jobs"] > 0
     assert ring_dv["ring"]["seq_mismatches"] == 0
+    # Three-way (ISSUE 12): MEGAROUND — the adaptive accumulator over
+    # mega dispatch tiers — must be bit-identical too, still with zero
+    # request-path blocking fetches and the sequence word monotone/
+    # mirror-consistent across whatever mix of base and mega tiers the
+    # schedule produced (seq_mismatches == 0 IS that assertion: every
+    # fetched device word matched the host mirror's running total).
+    mega_results, mega_rows, mega_dv = run_mode("megaround")
+    assert mega_results == base_results
+    assert mega_rows == base_rows
+    mr = mega_dv["ring"]
+    assert mr["rounds"] == 2 and mr["capacity"] == 8
+    assert mr["iterations"] + mr["host_jobs"] > 0
+    assert mr["seq_mismatches"] == 0
+    # Store-attached merges ride the runner as host jobs (no ring
+    # iterations); whenever ring iterations DID happen, the factor is
+    # well-formed.
+    if mr["iterations"]:
+        assert mr["rounds_per_dispatch"] >= 1.0

@@ -90,6 +90,48 @@ def test_mesh_ring_matches_classic_dispatch(frozen_clock):
     assert ring.rounds_consumed >= 6
 
 
+def test_mesh_megaround_matches_classic(frozen_clock):
+    """Megaround on the mesh (make_mesh_mega_ring_step): a backlog past
+    the base slot tier dispatches as ONE mega grid iteration, bit-
+    identical to the mesh-classic loop on every shard, per-shard seq
+    words still mirror-consistent across the mega tier."""
+    import threading
+
+    classic = MeshBackend(MESH_DEV, clock=frozen_clock)
+    ringed = MeshBackend(MESH_DEV, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=2, rounds=4, max_linger_us=20_000)
+    gate = threading.Event()
+    try:
+        ring.submit_host(gate.wait)  # stall so the backlog forms
+        waits = [
+            ring.submit_rounds(_grid_rounds(_reqs(s), frozen_clock))
+            for s in range(3)
+        ]
+        gate.set()
+        got = [w() for w in waits]
+        want = [
+            classic.step_rounds(
+                _grid_rounds(_reqs(s), frozen_clock), add_tally=False
+            )
+            for s in range(3)
+        ]
+        for g, w in zip(got, want):
+            assert len(g) == len(w)
+            for gh, wh in zip(g, w):
+                for col in RESP_COLS:
+                    v = wh[col]
+                    np.testing.assert_array_equal(
+                        v, gh[col][..., : v.shape[-1]], err_msg=col
+                    )
+        dv = ring.debug_vars()
+        assert dv["mega_iterations"] >= 1, dv
+        assert dv["seq_mismatches"] == 0, dv
+        assert ring.seq_shards == [ring.seq] * N
+    finally:
+        gate.set()
+        ring.close()
+
+
 def test_mesh_ring_coalesces_mixed_tiers(frozen_clock):
     """Grid merges packed at different batch tiers coalesce into one
     mesh ring block and come back at their own tiers (the

@@ -338,13 +338,169 @@ def test_close_mid_flight(frozen_clock):
     assert not ring.available()
 
 
+# -- megaround serving (ops/ring.mega_ring_step; docs/ring.md) ----------
+
+def test_mega_ring_step_matches_flat_scan(frozen_clock):
+    """The megaround kernel is the ring scan by construction: applying
+    qs.reshape(r, s, ...) through mega_ring_step produces the EXACT
+    table, responses, and sequence word of ring_step over the flat
+    [r*s, ...] block."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu.ops.ring import mega_ring_step, ring_step
+    from gubernator_tpu.ops.state import init_table
+    from gubernator_tpu.runtime.backend import pack_batch_q
+
+    qs = []
+    for s in range(4):
+        for db in _rounds(_reqs(s), frozen_clock):
+            qs.append(pack_batch_q(db))
+    qs = np.stack(qs).astype(np.int64)
+    k = qs.shape[0]
+    assert k % 2 == 0
+    now = np.int64(frozen_clock.millisecond_now())
+    nows = np.full(k, now, dtype=np.int64)
+    seq = jnp.zeros((), jnp.int64)
+
+    rt, rresp, rseq = ring_step(init_table(1024), qs, nows, seq, ways=8)
+    mt, mresp, mseq = mega_ring_step(
+        init_table(1024), qs.reshape(k // 2, 2, 12, qs.shape[-1]),
+        nows.reshape(k // 2, 2), seq, ways=8,
+    )
+    for f, a, b in zip(rt._fields, rt, mt):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rresp),
+        np.asarray(mresp).reshape(k, 9, qs.shape[-1]),
+    )
+    assert int(rseq) == int(mseq) == k
+
+
+def test_megaround_widens_under_load(frozen_clock):
+    """The adaptive round accumulator: a backlog past the base slot
+    tier dispatches as ONE mega iteration (rounds_per_dispatch >
+    slots), bit-identical to the classic round-at-a-time loop, with
+    the sequence word still monotone and mirror-consistent across the
+    mega tier."""
+    classic = DeviceBackend(DEV, clock=frozen_clock)
+    ringed = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(ringed, slots=2, rounds=4, max_linger_us=20_000)
+    gate = threading.Event()
+    try:
+        ring.submit_host(gate.wait)  # stall so a backlog forms
+        # 3 merges x 2 rounds = 6 queued rounds > slots (2): the runner
+        # must widen to the mega tier (8) instead of three base blocks.
+        waits = [
+            ring.submit_rounds(_rounds(_reqs(s), frozen_clock))
+            for s in range(3)
+        ]
+        gate.set()
+        got = [w() for w in waits]
+        want = [
+            classic.step_rounds(
+                _rounds(_reqs(s), frozen_clock), add_tally=False
+            )
+            for s in range(3)
+        ]
+        for g, w in zip(got, want):
+            assert len(g) == len(w)
+            for gh, wh in zip(g, w):
+                for col in ("status", "limit", "remaining", "reset_time",
+                            "stored", "stored_status", "found"):
+                    v = wh[col]
+                    np.testing.assert_array_equal(
+                        v, gh[col][..., : v.shape[-1]], err_msg=col
+                    )
+        dv = ring.debug_vars()
+        assert dv["iterations"] == 1, dv
+        assert dv["mega_iterations"] == 1, dv
+        assert dv["rounds_consumed"] == 6, dv
+        assert dv["rounds_per_dispatch"] == 6.0, dv
+        assert dv["seq_mismatches"] == 0, dv
+        # seq advanced by the padded mega tier, monotone.
+        assert ring.seq >= 6
+    finally:
+        gate.set()
+        ring.close()
+
+
+def test_shallow_queue_dispatches_immediately(frozen_clock):
+    """Megaround must never add latency to light traffic: a single
+    queued merge (<= the base slot tier) dispatches without waiting
+    out the linger bound, however large it is."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    # A linger bound far above the assertion budget: if the shallow
+    # path ever lingered, this test would take >= 1s and fail the
+    # elapsed check.
+    ring = RingBackend(be, slots=4, rounds=4, max_linger_us=1_000_000)
+    try:
+        ring.warmup()  # exclude compile time from the latency check
+        t0 = time.monotonic()
+        ring.submit_rounds(
+            _rounds([RateLimitReq(name="ring", unique_key="fast",
+                                  hits=1, limit=10, duration=60_000)],
+                    frozen_clock)
+        )()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.9, elapsed
+        assert ring.lingers == 0
+        assert ring.mega_iterations == 0
+    finally:
+        ring.close()
+
+
+def test_linger_is_bounded(frozen_clock):
+    """Once the queue is past the base tier but below the mega
+    capacity, the accumulator lingers for MORE rounds — but never past
+    GUBER_RING_MAX_LINGER_US: the block dispatches within the bound
+    even when nothing else arrives."""
+    be = DeviceBackend(DEV, clock=frozen_clock)
+    ring = RingBackend(be, slots=2, rounds=4, max_linger_us=150_000)
+    gate = threading.Event()
+    try:
+        ring.warmup()
+        ring.submit_host(gate.wait)  # stall so the backlog forms
+        # 2 merges x 2 rounds = 4 rounds: past slots (2), below
+        # capacity (8) — the linger case.
+        waits = [
+            ring.submit_rounds(_rounds(_reqs(s), frozen_clock))
+            for s in range(2)
+        ]
+        gate.set()
+        t0 = time.monotonic()
+        for w in waits:
+            w()
+        elapsed = time.monotonic() - t0
+        # The wait is the linger plus dispatch/fetch — bounded, not
+        # open-ended (generous slack for CI schedulers).
+        assert elapsed < 5.0, elapsed
+        assert ring.lingers == 1
+        # The accumulator waited SOME bounded time: more than nothing,
+        # never past the knob (+ scheduling slack).
+        assert 0.0 < ring.linger_s < 1.0, ring.linger_s
+        dv = ring.debug_vars()
+        assert dv["mega_iterations"] == 1, dv
+        assert dv["rounds_consumed"] == 4, dv
+    finally:
+        gate.set()
+        ring.close()
+
+
 def test_serve_mode_validation():
     assert normalize_serve_mode("") == "pipelined"
     assert normalize_serve_mode(" Ring ") == "ring"
+    assert normalize_serve_mode("Megaround") == "megaround"
+    assert normalize_serve_mode("persistent") == "persistent"
     with pytest.raises(ValueError, match="serve mode"):
         normalize_serve_mode("warp")
     with pytest.raises(ValueError, match="ring slots"):
         RingBackend(DeviceBackend(DEV), slots=0)
+    with pytest.raises(ValueError, match="ring rounds"):
+        RingBackend(DeviceBackend(DEV), slots=2, rounds=0)
+    with pytest.raises(ValueError, match="max_linger_us"):
+        RingBackend(DeviceBackend(DEV), slots=2, max_linger_us=-1.0)
 
 
 def test_ring_env_knobs(monkeypatch):
@@ -427,8 +583,19 @@ def test_fastpath_ring_fallback_modes(frozen_clock):
         fp = FastPath(svc, serve_mode="ring", ring_slots=2)
         assert fp.effective_serve_mode == "ring"
         assert fp._ring is not None
+        # Plain ring keeps the pre-megaround contract: capacity == the
+        # base slot tier, no accumulator.
+        assert fp._ring.rounds == 1 and fp._ring.capacity == 2
         fp._ring.broken = True  # simulate a device fault
         assert fp._ring_live() is None  # merges take the pipelined path
+        await fp.close()
+
+        fp = FastPath(svc, serve_mode="megaround", ring_slots=2,
+                      ring_rounds=4, ring_max_linger_us=100.0)
+        assert fp.effective_serve_mode == "megaround"
+        assert fp._ring is not None
+        assert fp._ring.rounds == 4 and fp._ring.capacity == 8
+        assert fp._ring.max_linger_s == pytest.approx(100e-6)
         await fp.close()
 
         # A backend WITHOUT ring support (not the mesh anymore) still

@@ -47,6 +47,15 @@ new-owner injection that can never clobber newer state).  The mesh
 backend's migration path deliberately adds none: it rides the
 registered sharded gather/load kernels through the generic
 PersistenceHost helpers.
+
+Megaround serving (docs/ring.md) adds TWO kernels: mega_ring_step
+(ops/ring.py — the scan OF the ring scan) and persistent_serve_step
+(ops/pallas/serve_kernel.py — the persistent decision kernel, traced
+through the interpret shim like cms_step_pallas).  The mesh megaround
+lift (parallel/sharded.make_mesh_mega_ring_step) deliberately adds
+none: it is the same shard_map composition mesh_ring_step already
+verifies, over the registered mega body — a factory, not a
+module-level jit, so the completeness checker's contract is unchanged.
 """
 from __future__ import annotations
 
@@ -216,6 +225,102 @@ def _migrate_spec(name: str, fn_name: str, impl_name: str,
 
     return KernelSpec(name=name, where="gubernator_tpu/ops/state.py",
                       build=build)
+
+
+def _mega_ring_spec() -> KernelSpec:
+    """ops/ring.py mega_ring_step: megaround serving's scan OF the ring
+    scan (docs/ring.md) — up to GUBER_RING_ROUNDS x GUBER_RING_SLOTS
+    stacked rounds per dispatch.  The outer scan threads (table, seq)
+    through ring_step_impl, so the taint and cast contract is exactly
+    ring_step's (11 to_f64 leaky float sites + 1 to_i32 algo narrowing
+    propagated through the nested scan carries); donation is table-only
+    — the seq word's keep rule is inherited from the base ring."""
+
+    def build() -> BuiltKernel:
+        import gubernator_tpu.ops.ring as ring_mod
+
+        def sig(r: int, s: int):
+            return lambda: (
+                _table(),
+                np.zeros((r, s, 12, 64), np.int64),
+                np.zeros((r, s), np.int64),
+                np.zeros((), np.int64),
+            )
+
+        return BuiltKernel(
+            fn=ring_mod.mega_ring_step,
+            trace_fn=functools.partial(
+                ring_mod.mega_ring_step_impl, ways=WAYS
+            ),
+            signatures={"r2s2": sig(2, 2), "r4s2": sig(4, 2)},
+            counters=_TABLE_COUNTERS + ("[1]", "[2]", "[3]"),
+            allowed_casts=dict(_APPLY_Q_CASTS),
+            perturbations={
+                # Caller-mistake replay: a python-int seq traces weak.
+                "weak-seq": lambda: (
+                    _table(), np.zeros((2, 2, 12, 64), np.int64),
+                    np.zeros((2, 2), np.int64), 0,
+                ),
+            },
+            recompile_budget=3,
+            expect_aliased=12,  # table only — seq deliberately kept
+        )
+
+    return KernelSpec(
+        name="mega_ring_step", where="gubernator_tpu/ops/ring.py",
+        build=build,
+    )
+
+
+def _persistent_serve_spec() -> KernelSpec:
+    """ops/pallas/serve_kernel.py persistent_serve_step: the persistent
+    decision kernel — one Pallas launch drains the whole request queue
+    with the table resident across grid steps (docs/ring.md).  Traced
+    through the interpret shim like cms_step_pallas (Mosaic needs a
+    real TPU; the interpret emulation is differentially pinned
+    bit-exact against ring_step).  The decision body runs INSIDE the
+    pallas_call, so the jaxpr-level cast walk sees only the wrapper's
+    input normalization — zero licensed casts (the body's leaky float
+    sites are covered where they are verified, on ring_step /
+    apply_batch_packed_q); donation is table-only via the jit wrapper
+    — the seq word rides the response queue un-donated, the ring keep
+    rule."""
+
+    def build() -> BuiltKernel:
+        import gubernator_tpu.ops.pallas.serve_kernel as sk
+
+        def sig(k: int):
+            return lambda: (
+                _table(),
+                np.zeros((k, 12, 64), np.int64),
+                np.zeros(k, np.int64),
+                np.zeros((), np.int64),
+            )
+
+        return BuiltKernel(
+            fn=_PallasInterpretShim(sk.persistent_serve_step),
+            trace_fn=functools.partial(
+                sk.persistent_serve_step_impl, ways=WAYS,
+                interpret=True,
+            ),
+            signatures={"k1": sig(1), "k2": sig(2)},
+            counters=_TABLE_COUNTERS + ("[1]", "[2]", "[3]"),
+            allowed_casts={},
+            perturbations={
+                "weak-seq": lambda: (
+                    _table(), np.zeros((1, 12, 64), np.int64),
+                    np.zeros(1, np.int64), 0,
+                ),
+            },
+            recompile_budget=3,
+            expect_aliased=12,  # table only — seq deliberately kept
+        )
+
+    return KernelSpec(
+        name="persistent_serve_step",
+        where="gubernator_tpu/ops/pallas/serve_kernel.py",
+        build=build,
+    )
 
 
 def _ring_spec() -> KernelSpec:
@@ -631,6 +736,9 @@ def specs() -> List[KernelSpec]:
         ),
         # -- ops/ring.py: the ring-fed device loop ----------------------
         _ring_spec(),
+        _mega_ring_spec(),
+        # -- ops/pallas/serve_kernel.py: the persistent decision kernel -
+        _persistent_serve_spec(),
         # -- ops/sketch.py + the fused Pallas form ----------------------
         _sketch_spec("cms_step_onehot", "cms_step_onehot",
                      "cms_step_impl"),
