@@ -502,6 +502,31 @@ def bench(seconds: float, concurrency: int,
                 budget["ring"] = rdv
         results.append(budget)
         print(json.dumps(budget), flush=True)
+
+        # End-of-run gubstat census (docs/observability.md): table
+        # occupancy and the top-K tenant ledger from the single-node
+        # daemon that served configs 1/2/4, so capacity trends ride the
+        # BENCH_E2E artifact trajectory next to the throughput numbers.
+        try:
+            d0 = c.daemons[0]
+            census = {"config": "table_census"}
+            if d0.stats_sampler is not None:
+                blk = c.run(d0.stats_sampler.sample(), timeout=120)
+                census.update({
+                    "occupancy": blk["occupancy"],
+                    "live": blk["live"],
+                    "expired_resident": blk["expired_resident"],
+                    "per_shard_occupancy": blk["per_shard_occupancy"],
+                    "bucket_fill": blk["bucket_fill"],
+                    "shadow_slots": blk["shadow_slots"],
+                })
+            if d0.service.tenants is not None:
+                census["tenants_top"] = d0.service.tenants.top(8)
+            results.append(census)
+            print(json.dumps(census), flush=True)
+        except Exception as e:  # census must never sink the bench run
+            print(json.dumps({"config": "table_census", "error": str(e)}),
+                  flush=True)
     finally:
         c.stop()
 

@@ -64,7 +64,9 @@ def main() -> None:
         def dump_flightrec() -> None:
             # SIGUSR2: operator-initiated flight-recorder dump (the Go
             # expvar/pprof-on-signal idiom).  Fire-and-forget on the loop;
-            # a disarmed recorder just logs where to turn it on.
+            # a disarmed recorder just logs where to turn it on.  The
+            # dump carries the gubstat `table` census block when the
+            # sampler is armed (flightrec extras, runtime/gubstat.py).
             if daemon.flightrec is None:
                 logging.getLogger("gubernator_tpu").warning(
                     "SIGUSR2: flight recorder disabled "

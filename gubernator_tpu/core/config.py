@@ -397,6 +397,62 @@ def reshard_config_from_env() -> ReshardConfig:
         ) from None
 
 
+@dataclass
+class StatsConfig:
+    """Gubstat — state-plane introspection (runtime/gubstat.py;
+    docs/observability.md; no reference analog — the Go daemon's cache
+    is host memory an operator can inspect ad hoc, the device table is
+    not).
+
+    The sampler dispatches the read-only ops/state.table_stats census
+    every `interval_s` as a ring host job (or an executor call outside
+    ring mode), so the request path never blocks on it.  `top_k`
+    bounds the per-tenant accounting surface (names tracked exactly;
+    hit totals ride the existing HostCMS sketch, so cardinality is
+    bounded however many tenants appear).  `peek` gates the
+    /debug/key inspection route (it decodes live counter state, which
+    an operator may prefer to keep off an exposed debug port)."""
+
+    enabled: bool = True
+    # Census cadence in seconds.
+    interval_s: float = 5.0
+    # Tenants surfaced in /debug/vars, /metrics, and gubtop.
+    top_k: int = 16
+    # Allow the /debug/key row-inspection route.
+    peek: bool = True
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"stats interval_s must be > 0, got {self.interval_s}"
+            )
+        if self.top_k < 1:
+            raise ValueError(
+                f"stats top_k must be >= 1, got {self.top_k}"
+            )
+
+
+def stats_config_from_env() -> StatsConfig:
+    """The gubstat plane's env parse (same contract as
+    hotkey_config_from_env): validation errors name the env surface at
+    startup instead of crashing a constructor later."""
+    try:
+        return StatsConfig(
+            enabled=_env("GUBER_STATS_ENABLED", "true").lower()
+            not in ("0", "false", "no"),
+            interval_s=_env_float_s("GUBER_STATS_INTERVAL", 5.0),
+            top_k=_env_int("GUBER_STATS_TOP_K", 16),
+            peek=_env("GUBER_STATS_PEEK", "true").lower()
+            not in ("0", "false", "no"),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "stats env config (GUBER_STATS_ENABLED, "
+            "GUBER_STATS_INTERVAL, GUBER_STATS_TOP_K, "
+            f"GUBER_STATS_PEEK): {e}"
+        ) from None
+
+
 def peer_debounce_ms_from_env() -> int:
     """Discovery-update coalescing window (GUBER_PEER_DEBOUNCE_MS): an
     etcd/k8s watch storm delivering N membership events within the
@@ -567,6 +623,9 @@ class Config:
     # Elastic membership / live slot migration (runtime/reshard.py;
     # docs/resharding.md).
     reshard: ReshardConfig = field(default_factory=ReshardConfig)
+    # Gubstat state-plane introspection (runtime/gubstat.py;
+    # docs/observability.md).
+    stats: StatsConfig = field(default_factory=StatsConfig)
 
 
 @dataclass
@@ -683,6 +742,9 @@ class DaemonConfig:
     # docs/resharding.md): a remap streams moved rows old owner -> new
     # owner instead of orphaning them.
     reshard: ReshardConfig = field(default_factory=ReshardConfig)
+    # Gubstat state-plane introspection (runtime/gubstat.py;
+    # docs/observability.md): census cadence, tenant top-K, /debug/key.
+    stats: StatsConfig = field(default_factory=StatsConfig)
     # Discovery-update coalescing window in ms (GUBER_PEER_DEBOUNCE_MS):
     # rapid watch events within the window apply as ONE latest-wins
     # remap.  0 = apply every event (still serialized).
@@ -1078,6 +1140,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         hotkey=hotkey_config_from_env(),
         lease=lease_config_from_env(),
         reshard=reshard_config_from_env(),
+        stats=stats_config_from_env(),
         peer_debounce_ms=peer_debounce_ms_from_env(),
         reshard_drain_on_close=_env(
             "GUBER_RESHARD_DRAIN_ON_CLOSE", "false"

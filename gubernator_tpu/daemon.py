@@ -327,6 +327,10 @@ class Daemon:
             )
         self.service: Optional[Service] = None
         self.fastpath = None
+        # Gubstat census sampler (runtime/gubstat.py): armed in start()
+        # per GUBER_STATS_ENABLED, closed before the fastpath (its ring
+        # host jobs need the runner alive).
+        self.stats_sampler = None
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._grpc_tls_proxy = None  # net.tls.TLSTerminatingProxy
         self._grpc_backend_dir: Optional[str] = None
@@ -368,6 +372,7 @@ class Daemon:
             shadow_fraction=getattr(self.conf, "shadow_fraction", 0.5),
             hotkey=getattr(self.conf, "hotkey", None) or Config().hotkey,
             lease=getattr(self.conf, "lease", None) or Config().lease,
+            stats=getattr(self.conf, "stats", None) or Config().stats,
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -401,6 +406,24 @@ class Daemon:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.fastpath._ring.warmup
             )
+        if cfg.stats.enabled:
+            # Gubstat census sampler: periodic table_stats census off
+            # the request path (docs/observability.md).  Registered as
+            # a flight-recorder extra so breach/SIGUSR2 dumps carry the
+            # last table block.
+            from gubernator_tpu.runtime.gubstat import TableStatsSampler
+
+            self.stats_sampler = TableStatsSampler(
+                self.service,
+                fastpath=self.fastpath,
+                metrics=self.metrics,
+                interval_s=cfg.stats.interval_s,
+            )
+            self.stats_sampler.start()
+            if self.flightrec is not None:
+                self.flightrec.extras["table"] = (
+                    lambda: self.stats_sampler.last
+                )
 
         # gRPC server (daemon.go:101-126): both services on one listener.
         # 4MB recv cap: grpc-go's default, which reference peers assume.
@@ -546,6 +569,11 @@ class Daemon:
         if self._http_runner is not None:
             await self._http_runner.cleanup()
             self._http_runner = None
+        if self.stats_sampler is not None:
+            # Before the fastpath: an in-flight sample may hold a ring
+            # host job that needs the runner to drain it.
+            await self.stats_sampler.close()
+            self.stats_sampler = None
         if self.fastpath is not None:
             await self.fastpath.close()
             self.fastpath = None
@@ -562,6 +590,7 @@ class Daemon:
         app.router.add_get("/metrics", self._http_metrics)
         app.router.add_get("/debug/flightrec", self._http_flightrec)
         app.router.add_get("/debug/vars", self._http_vars)
+        app.router.add_get("/debug/key", self._http_debug_key)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         host, _, port = self.conf.http_listen_address.rpartition(":")
@@ -653,6 +682,11 @@ class Daemon:
                     self.metrics.shard_ring_seq.labels(
                         shard=str(s)
                     ).set(word)
+            # Gubstat top-K tenant gauges: refreshed at scrape (stale
+            # tenant labels removed); the table census gauges refresh
+            # on the sampler's own cadence, never here.
+            if self.service.tenants is not None:
+                self.service.tenants.publish(self.metrics)
             # Per-peer rolling error windows (the HealthCheck signal,
             # peer_client.last_errors) as scrape-time gauges.
             for peer in (
@@ -783,6 +817,14 @@ class Daemon:
                     **s.reshard.debug_vars(),
                     "peer_updates_applied": self.peer_updates_applied,
                 }
+        if s is not None and s.tenants is not None:
+            # Gubstat per-tenant admission ledger (docs/observability.md).
+            out["tenants"] = s.tenants.debug_vars()
+        if self.stats_sampler is not None:
+            # Gubstat device-table census: the last sampled table block
+            # (occupancy, bucket fill, age/TTL histograms, shadow-plane
+            # census) plus sampler health.
+            out["table"] = self.stats_sampler.debug_vars()
         fp = self.fastpath
         if fp is not None:
             # Per-lane drain/pipeline counters (drains, overlap_drains,
@@ -803,6 +845,124 @@ class Daemon:
                 "last_dump_path": fr.last_dump_path,
             }
         return web.json_response(out)
+
+    @staticmethod
+    def _cache_item_json(item) -> Optional[dict]:
+        """Decoded host view of one slot-table row (CacheItem)."""
+        if item is None:
+            return None
+        out = {
+            "key": item.key,
+            "algorithm": int(item.algorithm),
+            "limit": int(item.limit),
+            "duration": int(item.duration),
+            "remaining": float(item.remaining),
+            "created_at": int(item.created_at),
+            "status": int(item.status),
+            "burst": int(item.burst),
+            "expire_at": int(item.expire_at),
+        }
+        if item.cached_resp is not None:
+            cr = item.cached_resp
+            out["cached_resp"] = {
+                "status": int(cr.status),
+                "limit": int(cr.limit),
+                "remaining": int(cr.remaining),
+                "reset_time": int(cr.reset_time),
+            }
+        return out
+
+    async def _http_debug_key(self, request: web.Request):
+        """Gubstat key inspection (docs/observability.md): the decoded
+        live row for `?name=...&key=...` plus its shadow-plane siblings
+        (.hot-mirror / .lease-grant / .degraded-shadow /
+        .handoff-shadow).  READ-ONLY — rides the backend's point-read
+        probe (no hits applied, the row is bit-identical afterwards) —
+        and owner-routed: a non-owner proxies to the owner's HTTP
+        listener so any node answers for any key cluster-wide.
+        Gated by GUBER_STATS_PEEK (row contents are operator data)."""
+        from gubernator_tpu.runtime.gubstat import PLANE_LABELS
+        from gubernator_tpu.ops.state import SHADOW_PLANES
+
+        s = self.service
+        if s is None:
+            return web.json_response({"error": "not started"}, status=503)
+        if not (s.cfg.stats.enabled and s.cfg.stats.peek):
+            return web.json_response(
+                {"error": "key peek disabled",
+                 "hint": "set GUBER_STATS_PEEK=1"},
+                status=403,
+            )
+        name = request.query.get("name", "")
+        key = request.query.get("key", "")
+        if not name:
+            return web.json_response({"error": "missing name"}, status=400)
+        hash_key = name + "_" + key
+        owner_addr = ""
+        if not s._owns_key(hash_key):
+            try:
+                info = s.get_peer(hash_key).info()
+            except Exception:
+                info = None
+            if info is not None:
+                owner_addr = info.grpc_address
+                if (
+                    info.http_address
+                    and request.query.get("noproxy", "") != "1"
+                ):
+                    # Route to the owner (one hop: the owner serves
+                    # with noproxy so a stale ring can't loop).
+                    import aiohttp
+
+                    scheme = "https" if self.tls is not None else "http"
+                    url = (
+                        f"{scheme}://{info.http_address}/debug/key"
+                    )
+                    ssl_ctx = (
+                        self.tls.client_ssl_context()
+                        if self.tls is not None
+                        else None
+                    )
+                    try:
+                        async with aiohttp.ClientSession() as sess:
+                            async with sess.get(
+                                url,
+                                params={
+                                    "name": name, "key": key,
+                                    "noproxy": "1",
+                                },
+                                ssl=ssl_ctx,
+                                timeout=aiohttp.ClientTimeout(total=5),
+                            ) as resp:
+                                body = await resp.json()
+                                body["proxied_via"] = self.http_address
+                                return web.json_response(
+                                    body, status=resp.status
+                                )
+                    except Exception as e:  # owner answers unreachable
+                        return web.json_response(
+                            {"error": f"owner proxy failed: {e}",
+                             "owner": owner_addr},
+                            status=502,
+                        )
+        be = s.backend
+        row = self._cache_item_json(be.get_cache_item(hash_key))
+        shadows = {
+            label: self._cache_item_json(
+                be.get_cache_item(hash_key + suffix)
+            )
+            for suffix, label in zip(SHADOW_PLANES, PLANE_LABELS)
+        }
+        return web.json_response({
+            "name": name,
+            "key": key,
+            "hash_key": hash_key,
+            "served_by": self.grpc_address,
+            "owner": owner_addr or self.grpc_address,
+            "found": row is not None,
+            "row": row,
+            "shadows": shadows,
+        })
 
     # -- peers / discovery ----------------------------------------------
     def advertise_address(self) -> str:

@@ -225,3 +225,152 @@ def migrate_inject_impl(
 migrate_inject = jax.jit(
     migrate_inject_impl, static_argnames=("ways",), donate_argnums=(0,)
 )
+
+
+# --------------------------------------------------------------------------
+# Gubstat (docs/observability.md): the one-pass state census.
+#
+# The table is the thing HBM capacity binds at scale, yet until now it
+# exported a single occupancy scalar.  table_stats computes the whole
+# introspection surface — occupancy, bucket-fill (probe/eviction
+# pressure), slot-age and TTL-expiry histograms, the remaining-fraction
+# distribution per algorithm, and a census of the reserved shadow-slot
+# classes — in ONE non-donated device pass, so a periodic sampler can
+# ride the ring runner's host-job queue without ever touching the
+# request path (the table is read, never written, and never donated).
+# --------------------------------------------------------------------------
+
+# The reserved derived-slot suffix classes, in census-row order.  The
+# table stores only 64-bit fingerprints, so the HOST enumerates the
+# derived keys it knows about (runtime/service.derived_slot_fps-style)
+# and passes their fingerprints per class; the kernel counts which are
+# live residents.  Order is a wire contract with runtime/gubstat.py.
+SHADOW_PLANES = (
+    ".hot-mirror", ".lease-grant", ".degraded-shadow", ".handoff-shadow"
+)
+
+# Slot-age / TTL-remaining histogram edges (ms): <=1s, <=10s, <=1m,
+# <=10m, <=1h, >1h.  Fixed at trace time — bins are part of the
+# compiled shape, one compile per table geometry.
+AGE_BIN_EDGES_MS = (1_000, 10_000, 60_000, 600_000, 3_600_000)
+AGE_BINS = len(AGE_BIN_EDGES_MS) + 1
+
+# Remaining-fraction bins over [0, 1] (bin k covers [k/8, (k+1)/8)).
+FRAC_BINS = 8
+
+
+class TableStats(NamedTuple):
+    """One sample of the state plane (all int64 counts)."""
+
+    occupancy: jax.Array           # int64[]: slots with a fingerprint
+    live: jax.Array                # int64[]: resident AND unexpired
+    expired_resident: jax.Array    # int64[]: resident but TTL-passed
+    bucket_fill: jax.Array         # int64[ways+1]: buckets with k residents
+    slot_age: jax.Array            # int64[AGE_BINS]: now - t0, live only
+    ttl_remaining: jax.Array       # int64[AGE_BINS]: expire_at - now, live
+    remaining_fraction: jax.Array  # int64[2, FRAC_BINS]: per algo enum
+    shadow_slots: jax.Array        # int64[len(SHADOW_PLANES)]: live carves
+
+
+def table_stats_impl(
+    table: SlotTable,
+    shadow_fps: jax.Array,  # int64[len(SHADOW_PLANES), M]; 0 = inactive
+    now: jax.Array,
+    ways: int = 8,
+) -> TableStats:
+    """The full census in one read-only pass; never mutates, never
+    donates — safe to dispatch against the live serving table under the
+    backend lock (or as a ring host job) at any time."""
+    S = table.key.shape[0]
+    nb = S // ways
+    now = jnp.asarray(now, dtype=jnp.int64)
+    resident = table.key != 0
+    alive = resident & (table.expire_at > now)
+    occupancy = jnp.sum(resident, dtype=jnp.int64)
+    live = jnp.sum(alive, dtype=jnp.int64)
+
+    # Bucket-fill: residents per bucket -> histogram over 0..ways.  A
+    # right-shifted distribution is probe/eviction pressure the scalar
+    # occupancy cannot show (hash skew fills some buckets at ways while
+    # the aggregate looks healthy).
+    per_bucket = jnp.sum(
+        resident.reshape(nb, ways), axis=1, dtype=jnp.int64
+    )
+    fill_levels = jnp.arange(ways + 1, dtype=jnp.int64)
+    bucket_fill = jnp.sum(
+        per_bucket[:, None] == fill_levels[None, :], axis=0,
+        dtype=jnp.int64,
+    )
+
+    # Slot-age / TTL-remaining histograms (live slots only).
+    edges = jnp.asarray(AGE_BIN_EDGES_MS, dtype=jnp.int64)
+    bins = jnp.arange(AGE_BINS, dtype=jnp.int64)
+
+    def hist(values: jax.Array) -> jax.Array:
+        idx = jnp.sum(
+            values[:, None] > edges[None, :], axis=1, dtype=jnp.int64
+        )
+        onehot = (idx[:, None] == bins[None, :]) & alive[:, None]
+        return jnp.sum(onehot, axis=0, dtype=jnp.int64)
+
+    slot_age = hist(now - table.t0)
+    ttl_remaining = hist(table.expire_at - now)
+
+    # Remaining-fraction distribution per algorithm.  Two licensed
+    # to_f64 casts (remaining and limit — exact below 2^53 like the
+    # step kernels' float sites); the bin index narrows to int32 (one
+    # licensed to_i32 — FRAC_BINS bounds it).
+    lim_f = jnp.maximum(table.limit.astype(jnp.float64), 1.0)
+    rem_f = jnp.where(
+        table.algo == 1,
+        table.remaining_f,
+        table.remaining.astype(jnp.float64),
+    )
+    frac = jnp.clip(rem_f / lim_f, 0.0, 1.0)
+    fbin = jnp.minimum(
+        (frac * FRAC_BINS).astype(jnp.int32), FRAC_BINS - 1
+    )
+    fbins = jnp.arange(FRAC_BINS, dtype=jnp.int32)
+    onehot = fbin[:, None] == fbins[None, :]
+    rows = []
+    for algo in (0, 1):
+        mask = alive & (table.algo == algo)
+        rows.append(
+            jnp.sum(onehot & mask[:, None], axis=0, dtype=jnp.int64)
+        )
+    remaining_fraction = jnp.stack(rows)
+
+    # Shadow-slot census: probe each host-enumerated derived-key
+    # fingerprint (the migrate_extract bucket walk, read-only) and
+    # count live residents per suffix class.
+    fp = shadow_fps.reshape(-1)
+    bucket = (
+        fp.astype(jnp.uint64) & jnp.uint64(nb - 1)
+    ).astype(jnp.int64)
+    sidx = (
+        bucket[:, None] * ways
+        + jnp.arange(ways, dtype=jnp.int64)[None, :]
+    )
+    match = (
+        (table.key[sidx] == fp[:, None])
+        & (fp[:, None] != 0)
+        & (table.expire_at[sidx] > now)
+    )
+    shadow_slots = jnp.sum(
+        match.any(axis=1).reshape(shadow_fps.shape), axis=1,
+        dtype=jnp.int64,
+    )
+
+    return TableStats(
+        occupancy=occupancy,
+        live=live,
+        expired_resident=occupancy - live,
+        bucket_fill=bucket_fill,
+        slot_age=slot_age,
+        ttl_remaining=ttl_remaining,
+        remaining_fraction=remaining_fraction,
+        shadow_slots=shadow_slots,
+    )
+
+
+table_stats = jax.jit(table_stats_impl, static_argnames=("ways",))

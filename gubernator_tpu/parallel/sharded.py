@@ -267,6 +267,30 @@ def make_sharded_gather(mesh, ways: int):
     return jax.jit(sharded)
 
 
+def make_sharded_table_stats(mesh, ways: int):
+    """Sharded state census (docs/observability.md): every shard runs
+    ops/state.table_stats_impl on its slice in one read-only pass and
+    keeps its own row — the output carries a leading [n] shard axis on
+    every TableStats leaf, so the host gets per-shard occupancy/fill
+    for free and sums for cluster totals.  The shadow fingerprint grid
+    is replicated (P()): a derived key only matches on its home shard
+    (inserts used the same bucket math), so per-class census sums
+    across shards are exact, never double counted."""
+    from gubernator_tpu.ops.state import TableStats, table_stats_impl
+
+    def _local(table: SlotTable, shadow_fps, now):
+        st = table_stats_impl(table, shadow_fps, now, ways=ways)
+        return TableStats(*[a[None] for a in st])
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P()),
+        out_specs=P(SHARD_AXIS),
+    )
+    return jax.jit(sharded)
+
+
 def drain_to_grids(per_shard: List[list], B: int, make_grid, fill_lane):
     """Drain per-shard row lists into consecutive [n, B] grids (overflow
     chunks into extra grids).  `fill_lane(grid, shard, lane, row)` writes
@@ -348,6 +372,7 @@ class MeshBackend(PersistenceHost):
         )
         self._probe_sharded = make_sharded_probe(self.mesh, cfg.ways)
         self._gather_sharded = make_sharded_gather(self.mesh, cfg.ways)
+        self._table_stats = make_sharded_table_stats(self.mesh, cfg.ways)
         self.checks = 0
         self.over_limit = 0
         self.not_persisted = 0
@@ -600,6 +625,11 @@ class MeshBackend(PersistenceHost):
             )
             self._probe_sharded(self.table, zeros, now)
             self._gather_sharded(self.table, zeros, now)
+            # Gubstat census executable at the sampler's minimum shadow
+            # pad tier (runtime/gubstat.py pads to powers of two from 8).
+            self._table_stats(
+                self.table, np.zeros((4, 8), dtype=np.int64), now
+            )
             self.table = self._cached_store(
                 self.table,
                 CachedRows(*[
@@ -942,3 +972,21 @@ class MeshBackend(PersistenceHost):
                 axis=1,
             )
         return [int(c) for c in np.asarray(counts)]
+
+    def table_stats_dispatch(self, shadow_fps: np.ndarray):
+        """Dispatch the sharded gubstat census under the lock and return
+        a zero-arg fetch closure (DeviceBackend.table_stats_dispatch's
+        contract: every fetched TableStats leaf carries a leading shard
+        axis — here one row per mesh shard, so the sampler gets the
+        per-shard occupancy skew for free and sums for totals)."""
+        from gubernator_tpu.ops.state import TableStats
+
+        now = np.int64(self.clock.millisecond_now())
+        fps = np.asarray(shadow_fps, dtype=np.int64)
+        with self._lock:
+            st = self._table_stats(self.table, fps, now)
+
+        def fetch() -> "TableStats":
+            return TableStats(*[np.asarray(a) for a in st])
+
+        return fetch

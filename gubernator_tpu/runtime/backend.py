@@ -962,6 +962,15 @@ class DeviceBackend(PersistenceHost):
                 ),
                 now,
             )
+            # Gubstat census executable at the sampler's minimum shadow
+            # pad tier (runtime/gubstat.py pads to powers of two from
+            # 8) — the periodic sample should never pay a cold compile.
+            from gubernator_tpu.ops.state import table_stats
+
+            table_stats(
+                self.table, np.zeros((4, 8), dtype=np.int64), now,
+                ways=self.cfg.ways,
+            )
         jax.block_until_ready(resp)
 
     # -- persistence device hooks (PersistenceHost) ----------------------
@@ -1112,6 +1121,28 @@ class DeviceBackend(PersistenceHost):
     def occupancy(self) -> int:
         with self._lock:
             return int(np.asarray(self.table.occupancy()))
+
+    def table_stats_dispatch(self, shadow_fps: np.ndarray):
+        """Dispatch the gubstat census (ops/state.table_stats) against
+        the live table under the lock and return a zero-arg fetch
+        closure.  The kernel is read-only and NON-donated, so the
+        serving table is untouched and the dispatched result buffers
+        are pinned to this table version — the sampler fetches them
+        off the request path (a ring host job or an executor thread)
+        while the lock is long released.  Every leaf of the fetched
+        TableStats carries a leading shard axis (length 1 here; the
+        mesh backend returns one row per shard)."""
+        from gubernator_tpu.ops.state import TableStats, table_stats
+
+        now = np.int64(self.clock.millisecond_now())
+        fps = np.asarray(shadow_fps, dtype=np.int64)
+        with self._lock:
+            st = table_stats(self.table, fps, now, ways=self.cfg.ways)
+
+        def fetch() -> "TableStats":
+            return TableStats(*[np.asarray(a)[None] for a in st])
+
+        return fetch
 
 
 class Tally(NamedTuple):

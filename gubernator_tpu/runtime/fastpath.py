@@ -2315,6 +2315,34 @@ class FastPath:
                 over_limit=n_over, kind="fastlane_drain",
             )
 
+        # Gubstat per-tenant ledger: same validity stance as the tally
+        # above (per-request status column, errored lanes masked).
+        # Fast-lane traffic is plane-direct — derived shadow keys are
+        # only synthesized on the object path — and name strings decode
+        # lazily, at most once per newly-admitted tenant.
+        ta = getattr(self.s, "tenants", None)
+        if ta is not None:
+            if len(entries) == 1:
+                t_names = entries[0].cols.name_hash
+                t_hits = entries[0].cols.hits
+            else:
+                t_names = np.concatenate(
+                    [e.cols.name_hash for e in entries]
+                )
+                t_hits = np.concatenate([e.cols.hits for e in entries])
+
+            def _decode_tenant(i: int):
+                off2 = 0
+                for e in entries:
+                    if i < off2 + e.cols.n:
+                        return self._decode_req(
+                            e.payload, e.cols, i - off2
+                        ).name
+                    off2 += e.cols.n
+                return None
+
+            ta.record_fast(t_names, t_hits, status, valid, _decode_tenant)
+
         sb = self.s.sketch_backend
         if sb is not None and sb.spill_enabled:
             # h_mach, not h: cascade-diverted duplicate occurrences never

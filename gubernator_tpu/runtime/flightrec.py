@@ -49,7 +49,7 @@ import logging
 import os
 import threading
 import time
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from gubernator_tpu.runtime import tracing
 
@@ -130,6 +130,12 @@ class FlightRecorder:
         self._profiling = False
         self._task: Optional[asyncio.Task] = None
         self._started_wall = time.time()
+        # Extra snapshot blocks: name -> zero-arg provider returning a
+        # JSON-able value (or None to skip).  The daemon registers the
+        # gubstat table census here so every breach/SIGUSR2 dump carries
+        # the last device-table state alongside the ring.  Providers
+        # must never raise into a dump — failures drop the block.
+        self.extras: Dict[str, Callable[[], object]] = {}
 
     # -- producers (any thread) ------------------------------------------
     def record(self, kind: str, **fields) -> None:
@@ -326,7 +332,7 @@ class FlightRecorder:
         if limit is not None:
             ring = ring[-limit:]
         p50, p99, n = self.percentiles()
-        return {
+        out = {
             "version": 1,
             "pid": os.getpid(),
             "started": self._started_wall,
@@ -354,6 +360,14 @@ class FlightRecorder:
             },
             "ring": ring,
         }
+        for name, provider in self.extras.items():
+            try:
+                val = provider()
+            except Exception:
+                continue
+            if val is not None:
+                out[name] = val
+        return out
 
     async def dump(self, reason: str) -> str:
         """Write a JSON snapshot; optionally start a time-boxed

@@ -476,6 +476,87 @@ class Metrics:
             registry=r,
         )
 
+        # -- gubstat: device-table census (runtime/gubstat.py;
+        #    docs/observability.md).  All refreshed on the sampler's
+        #    cadence (GUBER_STATS_INTERVAL), not at scrape — the census
+        #    is a device kernel, never run on the scrape path.
+        self.table_occupancy = Gauge(
+            "gubernator_table_occupancy",
+            "Resident slots in the device table at the last gubstat "
+            "census (live + expired-but-unreclaimed).",
+            registry=r,
+        )
+        self.table_live = Gauge(
+            "gubernator_table_live",
+            "Unexpired resident slots at the last gubstat census.",
+            registry=r,
+        )
+        self.table_expired_resident = Gauge(
+            "gubernator_table_expired_resident",
+            "Expired slots still resident (reclaimable by the next "
+            "victim claim) at the last gubstat census.",
+            registry=r,
+        )
+        self.table_bucket_fill = Gauge(
+            "gubernator_table_bucket_fill",
+            "Buckets with exactly `fill` resident slots (0..ways) — the "
+            "probe-length histogram; mass near `ways` means bucket "
+            "exhaustion and early evictions.",
+            ["fill"],
+            registry=r,
+        )
+        self.table_slot_age = Gauge(
+            "gubernator_table_slot_age",
+            "Live slots by age since creation (t0) at the last census.",
+            ["bucket"],  # le_1s | le_10s | le_1m | le_10m | le_1h | inf
+            registry=r,
+        )
+        self.table_ttl_remaining = Gauge(
+            "gubernator_table_ttl_remaining",
+            "Live slots by time remaining until TTL expiry.",
+            ["bucket"],  # le_1s | le_10s | le_1m | le_10m | le_1h | inf
+            registry=r,
+        )
+        self.table_remaining_fraction = Gauge(
+            "gubernator_table_remaining_fraction",
+            "Live slots by remaining/limit eighth (bucket 0 = nearly "
+            "exhausted, 7 = nearly full), per algorithm.",
+            ["algo", "bucket"],  # token | leaky; 0..7
+            registry=r,
+        )
+        self.table_shadow_slots = Gauge(
+            "gubernator_table_shadow_slots",
+            "Resident live slots per shadow plane (hot-mirror, "
+            "lease-grant, degraded-shadow, handoff-shadow) matched "
+            "against the enumerated derived-key fingerprints.",
+            ["plane"],
+            registry=r,
+        )
+        self.table_stats_samples = Counter(
+            "gubernator_table_stats_samples_total",
+            "Gubstat census samples taken since daemon start.",
+            registry=r,
+        )
+
+        # -- gubstat: per-tenant admission accounting ---------------------
+        self.tenant_hits = Gauge(
+            "gubernator_tenant_hits",
+            "Hits served locally per limit name and outcome (allowed / "
+            "denied / shed) for the current top-K tenants; labels for "
+            "tenants that fall out of the top-K are removed at refresh.",
+            ["name", "outcome"],
+            registry=r,
+        )
+        self.tenant_over_admitted = Gauge(
+            "gubernator_tenant_over_admitted",
+            "Hits admitted through a shadow plane's bounded carve "
+            "(mirror / lease / degraded / handoff) per top-K tenant — "
+            "the live view of the limit x (1 + fraction) admission "
+            "bound.",
+            ["name", "plane"],
+            registry=r,
+        )
+
     def note_check_error(self, error: str, n: int = 1) -> None:
         """Count a check error AND feed the flight recorder's
         error-storm window — the one call every rejection path uses so

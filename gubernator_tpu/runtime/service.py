@@ -232,6 +232,15 @@ class Service:
         self._mirror_fps_cache = None
         self.mirror_served = 0
         self.shed_served = 0
+        # Gubstat per-tenant admission ledger (runtime/gubstat.py;
+        # docs/observability.md): fed at the LOCAL serve choke points
+        # only (_check_local tail, fast-lane _finish_process, the shed
+        # path) so a cluster-wide sum never double-counts a hit.
+        self.tenants = None
+        if self.cfg.stats.enabled:
+            from gubernator_tpu.runtime.gubstat import TenantAccounting
+
+            self.tenants = TenantAccounting(self.cfg.stats.top_k)
         # Client-side admission leases (runtime/lease.py; docs/leases.md):
         # the owner-side grant/reconcile plane for the Lease/Reconcile
         # peer RPCs.  None when disabled — every grant then refuses.
@@ -424,15 +433,10 @@ class Service:
     # ------------------------------------------------------------------
     # elastic membership (runtime/reshard.py; docs/resharding.md)
     # ------------------------------------------------------------------
-    def derived_slot_fps(self) -> np.ndarray:
-        """int64 fingerprints of the derived slots this node can
-        invalidate locally — lease carve slots, hot-mirror allowances,
-        degraded shadows, handoff shadows.  The reshard plane excludes
-        them from migration: derived state re-homes by re-creation at
-        its new home (leases re-grant through the ring, mirrors
-        re-promote, shadows re-carve), never by copy."""
-        from gubernator_tpu.core.hashing import key_hash64
-
+    def _derived_slot_keys(self) -> List[str]:
+        """Hash-key strings of every derived slot this node knows about
+        (each ends with its reserved suffix class — lease carve,
+        hot-mirror, degraded shadow, handoff shadow)."""
         keys: List[str] = []
         if self.leases is not None:
             from gubernator_tpu.runtime.lease import LEASE_SUFFIX
@@ -454,12 +458,45 @@ class Service:
                     keys.extend(
                         k + HANDOFF_SUFFIX for k in ib.shadow
                     )
+        return keys
+
+    def derived_slot_fps(self) -> np.ndarray:
+        """int64 fingerprints of the derived slots this node can
+        invalidate locally — lease carve slots, hot-mirror allowances,
+        degraded shadows, handoff shadows.  The reshard plane excludes
+        them from migration: derived state re-homes by re-creation at
+        its new home (leases re-grant through the ring, mirrors
+        re-promote, shadows re-carve), never by copy."""
+        from gubernator_tpu.core.hashing import key_hash64
+
+        keys = self._derived_slot_keys()
         if not keys:
             return _EMPTY_I64
         return np.array(
             [np.uint64(key_hash64(k)).view(np.int64) for k in keys],
             dtype=np.int64,
         )
+
+    def derived_slot_fps_by_plane(self) -> Dict[str, np.ndarray]:
+        """The same enumeration grouped by reserved suffix class (the
+        ops/state.SHADOW_PLANES census order) — the gubstat sampler's
+        input: each plane's fingerprints probe the live table so the
+        carve-slot population is observable per class."""
+        from gubernator_tpu.core.hashing import key_hash64
+        from gubernator_tpu.ops.state import SHADOW_PLANES
+
+        grouped: Dict[str, List[int]] = {p: [] for p in SHADOW_PLANES}
+        for k in self._derived_slot_keys():
+            for p in SHADOW_PLANES:
+                if k.endswith(p):
+                    grouped[p].append(
+                        int(np.uint64(key_hash64(k)).view(np.int64))
+                    )
+                    break
+        return {
+            p: np.array(v, dtype=np.int64) if v else _EMPTY_I64
+            for p, v in grouped.items()
+        }
 
     def _invalidate_unowned_mirrors(self) -> None:
         """A remap can make this node the OWNER of a key it was
@@ -852,6 +889,8 @@ class Service:
         self.metrics.peer_shed_total.labels(
             peerAddr="local", reason="pressure"
         ).inc()
+        if self.tenants is not None:
+            self.tenants.record_shed(req.name, int(req.hits or 0))
         retry_ms = int(self.cfg.hotkey.shed_cooldown_s * 1000)
         now_ms = int(self.clock.now_ns() // 1_000_000)
         return RateLimitResp(
@@ -1151,9 +1190,17 @@ class Service:
                     [reqs[i] for i in ex_idx],
                     [use_cached[i] for i in ex_idx] if use_cached else None,
                 )
+                if self.tenants is not None:
+                    self.tenants.record_checks(reqs, out)
                 return out  # type: ignore[return-value]
         resps = await self._local_batcher.check(reqs, use_cached)
         self._touch_global_captures(reqs, use_cached)
+        # Gubstat: every LOCAL device serve — direct and every shadow
+        # plane (mirror / lease / degraded / handoff reqs all ride
+        # through here with their suffixed unique_key) — tallies into
+        # the per-tenant ledger exactly once, at this choke point.
+        if self.tenants is not None:
+            self.tenants.record_checks(reqs, resps)
         return resps
 
     def _touch_global_captures(

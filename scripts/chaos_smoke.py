@@ -76,6 +76,25 @@ def _dump_flightrec(cluster, reason: str) -> None:
             print(f"flightrec dump ({d.grpc_address}): {path}")
 
 
+def _merged_tenant(daemons, name: str) -> dict:
+    """The cluster-wide per-tenant ledger, merged from LIVE /debug/vars
+    scrapes with gubtop's own merge (docs/observability.md) — the
+    production metrics surface, never test internals.  Local-serve
+    counting makes the sum exact, so the paper's over-admission bounds
+    are asserted against what an operator actually sees."""
+    from gubernator_tpu.cli import gubtop
+
+    scrapes = {d.http_address: gubtop.scrape(d.http_address)
+               for d in daemons}
+    for t in gubtop._merge_tenants(scrapes, 64):
+        if t["name"] == name:
+            return t
+    raise AssertionError(
+        f"tenant {name!r} missing from merged /debug/vars ledgers: "
+        f"{[v.get('tenants') for v in scrapes.values()]}"
+    )
+
+
 def storm_scenario(seed: int) -> None:
     from gubernator_tpu.client import V1Client
     from gubernator_tpu.core.config import CircuitConfig, DaemonConfig
@@ -346,6 +365,26 @@ def hotkey_scenario(seed: int) -> None:
             assert admitted >= hot_limit * 0.75, (
                 f"storm never saturated the key ({admitted})"
             )
+            # The same bound, reproduced from the LIVE metrics surface
+            # (docs/observability.md): every mirror admission is a
+            # client-visible UNDER_LIMIT, so the merged ledger's
+            # hot-mirror over-admission is positive (mirroring really
+            # served), never exceeds the admissions the client saw,
+            # and accounts for every admission past the base limit.
+            # (The cumulative counter can pass fraction x limit across
+            # demote/re-promote cycles — the per-window carve bound is
+            # what `admitted <= bound` above pins.)
+            over = _merged_tenant(cluster.daemons, "hot")[
+                "over_admitted"
+            ].get("hot-mirror", 0)
+            assert 0 < over <= admitted, (
+                f"live hot-mirror over-admission {over} outside "
+                f"(0, admitted {admitted}]"
+            )
+            assert admitted <= hot_limit + over, (
+                f"admitted {admitted} > limit {hot_limit} + live "
+                f"over-admission {over}"
+            )
 
             # Priority-ordered shedding on the pressured owner: the
             # sheddable class drops with retry-after, the unmatched
@@ -383,6 +422,14 @@ def hotkey_scenario(seed: int) -> None:
             finally:
                 cl_o.close()
             shed_total = owner.service.shed_served
+            # Shedding is tenant-attributed on the live surface too:
+            # the shed class shows shed hits, the kept class none.
+            assert _merged_tenant(
+                cluster.daemons, "bulk.jobs"
+            )["shed"] >= 1, "live ledger missed the shed tenant"
+            assert _merged_tenant(
+                cluster.daemons, "keep"
+            )["shed"] == 0, "unmatched-priority tenant counted as shed"
 
             # Phase 2 — the skew clears: pressure drains out of the
             # rolling window, the hot-set demotes to empty, and the
@@ -609,6 +656,22 @@ def lease_scenario(seed: int) -> None:
                 )
             time.sleep(0.1)
 
+        # The lease bound from the LIVE metrics surface
+        # (docs/observability.md): each granted carve counts its
+        # allowance as lease-grant over-admission at the owner — two
+        # grants landed (key pre-partition, key2 post-heal) and the
+        # per-window carve budget (allowance x max_holders) makes a
+        # third carve impossible, so the merged ledger shows EXACTLY
+        # 2 x allowance.  That is the live form of the paper's
+        # limit x (1 + holders x fraction) admission bound.
+        over = _merged_tenant(cluster.daemons, "lease")[
+            "over_admitted"
+        ].get("lease-grant", 0)
+        assert over == 2 * allowance, (
+            f"live lease-grant over-admission {over} != "
+            f"2 x allowance {2 * allowance}"
+        )
+
         print(
             f"lease smoke OK: seed={seed} key={hash_key} "
             f"owner={owner.grpc_address} admitted={admitted} "
@@ -762,6 +825,19 @@ def reshard_scenario(seed: int) -> None:
             total = admitted + shadow_admitted
             bound = int(limit * (1 + fraction))
             assert total == bound, f"admitted {total} != bound {bound}"
+            # The same bound from the LIVE metrics surface
+            # (docs/observability.md): every admission past the base
+            # limit rode the joiner's handoff shadow, so the merged
+            # ledger's handoff-shadow over-admission is EXACTLY the
+            # handoff_fraction x limit budget — limit x (1 + fraction)
+            # as an operator-visible number.
+            over = _merged_tenant(cluster.daemons, "churn")[
+                "over_admitted"
+            ].get("handoff-shadow", 0)
+            assert over == budget, (
+                f"live handoff-shadow over-admission {over} != "
+                f"budget {budget}"
+            )
 
             # Phase 2: HEAL — the transfer completes, the shadow burns
             # reconcile, and the new owner is authoritative.

@@ -1,0 +1,336 @@
+"""CI smoke: the gubstat observability plane end-to-end on a 3-daemon
+cluster (docs/observability.md).
+
+Asserts, strictly from the HTTP surface (/debug/vars, /metrics,
+/debug/key) — never from test-internal state:
+
+  1. census sampling: every node's /debug/vars grows a `table` block
+     (the sampler ticking inside the daemon loop) and /metrics exports
+     the gubernator_table_* families;
+  2. tenant attribution: the cluster-wide merged ledger (gubtop's own
+     merge over per-node local-serve counters) reproduces the driven
+     admissions EXACTLY — allowed == admitted hits, denied == rejected
+     hits — because forwarded responses are only counted by the owner;
+  3. gubtop renders one cluster screen (module call, no subprocess)
+     showing every node and the driven tenant;
+  4. /debug/key owner routing: a non-owner answers for an owned key via
+     one proxy hop, the decoded row matches the driven arithmetic, and
+     the read is non-mutating (bit-identical second response);
+  5. occupancy is conserved across a reshard JOIN: every driven row is
+     still found exactly once (same remaining, via owner routing) after
+     a fourth daemon joins, the joiner's census shows the moved rows
+     resident, and the demoted owner no longer holds them.
+
+On any failure each daemon's flight recorder dumps its ring to
+GUBER_FLIGHTREC_DIR (default stats-smoke-dumps/) so the CI artifact
+step can pick them up.
+
+Run from the repo root:  python scripts/stats_smoke.py [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable from a checkout without an installed package.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LIMIT = 100
+HOT_LIMIT = 5
+DURATION = 60_000
+KEYS = 10
+HITS_PER_KEY = 3
+TENANT = "smoketen"
+
+
+def _dump_flightrec(cluster, extra, reason: str) -> None:
+    for d in list(cluster.daemons) + list(extra):
+        if d.flightrec is not None:
+            path = cluster.run(d.flightrec.dump(reason))
+            print(f"flightrec dump ({d.grpc_address}): {path}")
+
+
+def _get(addr: str, path: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(
+        f"http://{addr}{path}", timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1337)
+    args = ap.parse_args()
+
+    from dataclasses import replace
+
+    from gubernator_tpu.cli import gubtop
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import (
+        DaemonConfig,
+        StatsConfig,
+        fast_test_behaviors,
+    )
+    from gubernator_tpu.core.types import PeerInfo, RateLimitReq, Status
+    from gubernator_tpu.daemon import Daemon
+    from gubernator_tpu.net.replicated_hash import (
+        ReplicatedConsistentHash,
+        xx_64,
+    )
+    from gubernator_tpu.testing import Cluster
+    from gubernator_tpu.testing.cluster import TEST_DEVICE
+
+    conf = DaemonConfig(
+        stats=StatsConfig(interval_s=0.3),
+        flightrec=True,
+        flightrec_dir=os.environ.get(
+            "GUBER_FLIGHTREC_DIR", "stats-smoke-dumps"
+        ),
+    )
+    cluster = Cluster.start_with(["", "", ""], conf_template=conf)
+    extra = []
+    try:
+        http = [d.http_address for d in cluster.daemons]
+
+        # ---- drive: KEYS keys x HITS_PER_KEY admitted hits, plus one
+        # hot key saturated past its limit so `denied` is non-zero.
+        keys = [f"k{i}" for i in range(KEYS)]
+        cl = V1Client(cluster.addresses()[0])
+        denied = 0
+        try:
+            for k in keys:
+                for _ in range(HITS_PER_KEY):
+                    r = cl.get_rate_limits([RateLimitReq(
+                        name=TENANT, unique_key=k, hits=1,
+                        limit=LIMIT, duration=DURATION,
+                    )], timeout=30)[0]
+                    assert r.error == "", r
+                    assert r.status == Status.UNDER_LIMIT, r
+            for _ in range(HOT_LIMIT + 3):
+                r = cl.get_rate_limits([RateLimitReq(
+                    name=TENANT, unique_key="hot", hits=1,
+                    limit=HOT_LIMIT, duration=DURATION,
+                )], timeout=30)[0]
+                assert r.error == "", r
+                if r.status == Status.OVER_LIMIT:
+                    denied += 1
+        finally:
+            cl.close()
+        assert denied == 3, f"hot key denied {denied} != 3"
+        allowed = KEYS * HITS_PER_KEY + HOT_LIMIT
+
+        # ---- 1: census sampling on every node -----------------------
+        # The first census ticks pay the jit compile, so a post-traffic
+        # sample may lag; freshness is part of the wait condition — the
+        # cluster-wide LIVE count must account for every driven row
+        # (occupancy additionally counts expired residents, e.g. the
+        # boot warmup row, so `live` is the exact quantity here).
+        deadline = time.monotonic() + 30.0
+        scrapes = {}
+        while True:
+            scrapes = {a: gubtop.scrape(a) for a in http}
+            sampled = all(
+                v.get("table", {}).get("samples", 0) >= 1
+                and "tenants" in v
+                for v in scrapes.values()
+            )
+            total_live = sum(
+                v.get("table", {}).get("live", 0)
+                for v in scrapes.values()
+            )
+            if sampled and total_live >= KEYS + 1:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"census never accounted for the driven rows "
+                    f"(live {total_live} < {KEYS + 1}): "
+                    f"{[(a, v.get('table')) for a, v in scrapes.items()]}"
+                )
+            time.sleep(0.1)
+        for a in http:
+            with urllib.request.urlopen(
+                f"http://{a}/metrics", timeout=5
+            ) as r:
+                body = r.read().decode()
+            for fam in ("gubernator_table_occupancy",
+                        "gubernator_table_bucket_fill",
+                        "gubernator_tenant_hits"):
+                assert fam in body, f"{fam} missing from {a}/metrics"
+
+        # ---- 2: exact cluster-wide tenant attribution ---------------
+        merged = {
+            t["name"]: t
+            for t in gubtop._merge_tenants(scrapes, KEYS + 4)
+        }
+        t = merged.get(TENANT)
+        assert t is not None, f"tenant {TENANT} not in merged top-K"
+        assert t["allowed"] == allowed, (
+            f"merged allowed {t['allowed']} != driven {allowed}"
+        )
+        assert t["denied"] == denied, (
+            f"merged denied {t['denied']} != driven {denied}"
+        )
+
+        # ---- 3: gubtop renders the cluster --------------------------
+        screen = gubtop.render(http, top_k=5)
+        assert TENANT in screen, screen
+        for a in http:
+            assert a in screen, f"node {a} missing from gubtop:\n{screen}"
+
+        # ---- 4: /debug/key owner routing, non-mutating --------------
+        probe = next(
+            k for k in keys
+            if not cluster.daemons[0].service._owns_key(f"{TENANT}_{k}")
+        )
+        q = f"/debug/key?name={TENANT}&key={probe}"
+        first = _get(http[0], q)
+        assert first.get("proxied_via") == http[0], first
+        assert first["found"] is True, first
+        assert first["row"]["remaining"] == float(
+            LIMIT - HITS_PER_KEY
+        ), first["row"]
+        second = _get(http[0], q)
+        second.pop("proxied_via", None)
+        first.pop("proxied_via", None)
+        assert first == second, (
+            f"/debug/key mutated the row:\n{first}\n{second}"
+        )
+
+        # ---- 5: occupancy conserved across a reshard JOIN -----------
+        pre_rows = {
+            k: _get(http[0], f"/debug/key?name={TENANT}&key={k}")["row"]
+            for k in keys + ["hot"]
+        }
+
+        async def boot():
+            c = replace(
+                conf,
+                grpc_listen_address="127.0.0.1:0",
+                http_listen_address="127.0.0.1:0",
+                behaviors=fast_test_behaviors(),
+                device=TEST_DEVICE,
+            )
+            d = Daemon(c)
+            await d.start()
+            d.conf.advertise_address = d.grpc_address
+            return d
+
+        d3 = cluster.run(boot(), timeout=300.0)
+        extra.append(d3)
+
+        class _P:
+            def __init__(self, addr):
+                self._i = PeerInfo(grpc_address=addr)
+
+            def info(self):
+                return self._i
+
+        def owner_addr(hash_key, addrs):
+            pick = ReplicatedConsistentHash(xx_64)
+            for a in addrs:
+                pick.add(_P(a))
+            return pick.get(hash_key).info().grpc_address
+
+        three = [d.grpc_address for d in cluster.daemons]
+        four = three + [d3.grpc_address]
+        movers = [
+            k for k in keys
+            if owner_addr(f"{TENANT}_{k}", four) == d3.grpc_address
+        ]
+        demoted = {
+            owner_addr(f"{TENANT}_{k}", three): k for k in movers
+        }
+
+        cluster.daemons.append(d3)
+        extra.remove(d3)
+        cluster.run(cluster._push_peers(), timeout=60.0)
+        # Outcome-based settle: every moved row becomes visible at its
+        # new owner (TRANSFER -> CUTOVER landed its slots), and no
+        # handoff is left half-open anywhere.  A started==completed
+        # check alone would pass trivially BEFORE the first handoff
+        # begins.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            moved_ok = all(
+                _get(
+                    http[0], f"/debug/key?name={TENANT}&key={k}"
+                )["found"]
+                for k in movers
+            )
+            settled = not d3.service.reshard._inbound and all(
+                d.service.reshard.handoffs_started
+                == d.service.reshard.handoffs_completed
+                + d.service.reshard.handoffs_aborted
+                for d in cluster.daemons
+            )
+            if moved_ok and settled:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"reshard handoffs never settled: movers={movers} "
+                f"ledgers={[d.service.reshard.debug_vars() for d in cluster.daemons]}"
+            )
+
+        # Every driven row still found exactly once via owner routing,
+        # remaining bit-identical — no row lost, none double-applied.
+        for k, pre in pre_rows.items():
+            post = _get(http[0], f"/debug/key?name={TENANT}&key={k}")
+            assert post["found"] is True, (k, post)
+            assert post["row"]["remaining"] == pre["remaining"], (
+                f"key {k}: remaining {post['row']['remaining']} "
+                f"!= pre-join {pre['remaining']}"
+            )
+            assert post["row"]["created_at"] == pre["created_at"], k
+        # The joiner's census shows the moved rows resident (poll: its
+        # sampler needs a tick after the handoff completes)...
+        if movers:
+            deadline = time.monotonic() + 15.0
+            while True:
+                v3 = gubtop.scrape(d3.http_address)
+                if v3.get("table", {}).get("live", 0) >= len(movers):
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"joiner census {v3.get('table')} misses "
+                        f"{len(movers)} moved rows"
+                    )
+                time.sleep(0.1)
+            # ...and the demoted owner no longer holds them.
+            for old, k in demoted.items():
+                d_old = next(
+                    d for d in cluster.daemons
+                    if d.grpc_address == old
+                )
+                gone = _get(
+                    d_old.http_address,
+                    f"/debug/key?name={TENANT}&key={k}&noproxy=1",
+                )
+                assert gone["found"] is False, (
+                    f"demoted owner still holds {k}: {gone}"
+                )
+
+        print(
+            f"stats smoke OK: seed={args.seed} "
+            f"merged tenant {TENANT} allowed={allowed} denied={denied} "
+            f"exactly, census live {total_live} across 3 nodes, "
+            f"gubtop rendered {len(http)} nodes, /debug/key proxied + "
+            f"bit-identical re-read, {len(movers)} rows conserved "
+            f"across reshard join"
+        )
+    except BaseException:
+        _dump_flightrec(cluster, extra, "stats-smoke-failure")
+        raise
+    finally:
+        for d in extra:
+            cluster.run(d.close(), timeout=60.0)
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,11 @@ ALLOWED_SUFFIXES = (
     # the one place ring-mode device->host syncs are supposed to live
     # (docs/ring.md; the request path stays fetch-free).
     "runtime/ring.py",
+    # The gubstat sampler fetches census leaves on the executor thread
+    # (host-job submit + run_in_executor), and the tenant ledger only
+    # regroups arrays the fast lane already fetched — its np.asarray
+    # calls are host->host (docs/observability.md).
+    "runtime/gubstat.py",
     "runtime/checkpoint.py",
     "runtime/sketch_backend.py",
     "runtime/store.py",

@@ -47,6 +47,7 @@ CLASS_LOCK_MAP = {
     ("LeaseManager", "_lock"): "lease._lock",
     ("_LeaseTable", "_lock"): "lease.client._lock",
     ("ReshardManager", "_lock"): "reshard._lock",
+    ("TenantAccounting", "_lock"): "gubstat._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
@@ -67,6 +68,8 @@ VAR_ALIAS = {
     "lm": "lease",
     "flightrec": "flightrec",
     "fr": "flightrec",
+    "tenants": "gubstat",
+    "ta": "gubstat",
 }
 # Declared global acquisition order (lower rank acquired first).
 # flightrec._lock ranks LAST: any layer may record into the flight
@@ -109,6 +112,11 @@ RANK = {
     # any device work (extraction/injection ride the device executor
     # outside it).
     "reshard._lock": 58,
+    # gubstat._lock (runtime/gubstat.py tenant ledger) is a leaf: taken
+    # from the _check_local tail (event loop) and fast-lane fetch
+    # threads while holding nothing, guards only dict/CMS state, and
+    # takes nothing while held (name decode closures touch no locks).
+    "gubstat._lock": 59,
     "flightrec._lock": 60,
     # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
     # flightrec: span bookkeeping may run under ANY layer's lock (a span

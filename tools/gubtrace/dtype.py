@@ -63,9 +63,13 @@ def _bucket(dtype_name: str) -> str:
 
 
 # Ops that only reshape/relocate an index lineage without using values.
+# pbroadcast qualifies: shard_map inserts it to replicate a P()-specced
+# value across the mesh axis (e.g. a replicated fingerprint grid whose
+# derived bucket indices feed a gather over the sharded table) — it
+# moves the lineage between devices without consuming it.
 _SHAPE_ONLY = frozenset({
     "broadcast_in_dim", "reshape", "concatenate", "slice", "squeeze",
-    "expand_dims", "transpose", "rev", "copy",
+    "expand_dims", "transpose", "rev", "copy", "pbroadcast",
 })
 
 

@@ -227,6 +227,44 @@ def _migrate_spec(name: str, fn_name: str, impl_name: str,
                       build=build)
 
 
+def _table_stats_spec() -> KernelSpec:
+    """ops/state.py table_stats: the gubstat one-pass state census
+    (docs/observability.md) — occupancy, bucket-fill, slot-age / TTL
+    histograms, per-algorithm remaining-fraction distribution, and the
+    shadow-slot census over host-enumerated derived-key fingerprints.
+    Read-only and NON-donated by contract (it dispatches against the
+    live serving table as a ring host job); two licensed to_f64 casts
+    (remaining and limit at the fraction site, exact below 2^53 like
+    the step kernels' float sites — the f64->i32 bin index that
+    follows rides converted float lineage, so it is not charged)."""
+
+    def build() -> BuiltKernel:
+        import gubernator_tpu.ops.state as state
+
+        def sig(M: int):
+            return lambda: (
+                _table(), np.zeros((4, M), np.int64), _now()
+            )
+
+        return BuiltKernel(
+            fn=state.table_stats,
+            trace_fn=functools.partial(state.table_stats_impl, ways=WAYS),
+            signatures={"M8": sig(8), "M16": sig(16)},
+            counters=_TABLE_COUNTERS + ("[1]", "[2]"),
+            allowed_casts={"to_f64": 2},
+            perturbations={
+                "weak-now": lambda: (
+                    _table(), np.zeros((4, 8), np.int64), 0
+                ),
+            },
+            recompile_budget=3,
+            expect_aliased=0,
+        )
+
+    return KernelSpec(name="table_stats",
+                      where="gubernator_tpu/ops/state.py", build=build)
+
+
 def _mega_ring_spec() -> KernelSpec:
     """ops/ring.py mega_ring_step: megaround serving's scan OF the ring
     scan (docs/ring.md) — up to GUBER_RING_ROUNDS x GUBER_RING_SLOTS
@@ -665,6 +703,8 @@ def specs() -> List[KernelSpec]:
             "sharded_probe": lambda: sh.make_sharded_probe(_mesh(), WAYS),
             "sharded_gather":
                 lambda: sh.make_sharded_gather(_mesh(), WAYS),
+            "sharded_table_stats":
+                lambda: sh.make_sharded_table_stats(_mesh(), WAYS),
         }[name]
 
     def row_factory(impl_name, row_type_name):
@@ -734,6 +774,8 @@ def specs() -> List[KernelSpec]:
             _TABLE_COUNTERS + (".key_hash", ".limit", ".duration", "[2]"),
             {"to_f64": 1}, donated=12,
         ),
+        # -- ops/state.py: the gubstat state census ---------------------
+        _table_stats_spec(),
         # -- ops/ring.py: the ring-fed device loop ----------------------
         _ring_spec(),
         _mega_ring_spec(),
@@ -775,6 +817,12 @@ def specs() -> List[KernelSpec]:
             "sharded_gather", f_step("sharded_gather"),
             lambda: (_hash_grid(),),
             _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=0,
+        ),
+        _mesh_spec(
+            "sharded_table_stats", f_step("sharded_table_stats"),
+            lambda: (np.zeros((4, 8), np.int64),),
+            _TABLE_COUNTERS + ("[1]", "[2]"),
+            {"to_f64": 2}, donated=0,
         ),
         _mesh_ring_spec(),
         _global_sync_spec(),
