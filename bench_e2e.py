@@ -1112,9 +1112,118 @@ def bench(seconds: float, concurrency: int,
     if workload:
         try:
             kind, _, arg = workload.partition(":")
-            if kind != "zipf":
+            if kind not in ("zipf", "churn"):
                 raise ValueError(f"unknown workload {workload!r}; "
-                                 "expected zipf:<s>")
+                                 "expected zipf:<s> or churn:<keys>")
+        except ValueError as e:
+            print(json.dumps({"workload": workload, "error": str(e)}))
+            kind = ""
+
+    # ---- --workload churn:<keys>: tiered-table churn ------------------
+    # A keyspace far larger than the HBM slot budget with zipfian reuse
+    # — the Guberberg acceptance workload (docs/tiering.md): watermark
+    # demotion runs live while cold-resident keys promote back on
+    # access, and the budget columns show what the tier costs (cold-hit
+    # rate, promote latency, demotion rate) next to the usual
+    # percentiles and the fetch-free pin.
+    if workload and kind == "churn":
+        try:
+            keys = int(arg or "50000")
+            from gubernator_tpu.core.config import TierConfig
+
+            churn_dev = DeviceConfig(
+                num_slots=4096, ways=8, batch_size=1024
+            )
+            c = Cluster.start_with(
+                [""], device=churn_dev,
+                conf_template=conf(tier=TierConfig(
+                    enabled=True, cold_capacity=max(keys, 1),
+                    high_water=0.60, low_water=0.40,
+                    demote_batch=256, interval_s=0.25,
+                )),
+            )
+            try:
+                from gubernator_tpu.testing.chaos import zipf_keys
+
+                draws = zipf_keys(11, 1.1, 64 * 1000, keys)
+                cpays = [
+                    build_payload([
+                        ("bench_churn", f"c{k}")
+                        for k in draws[j * 1000:(j + 1) * 1000]
+                    ], limit=1_000_000, duration=60_000)
+                    for j in range(64)
+                ]
+                addr = [c.daemons[0].grpc_address]
+                c.run(drive(addr, cpays, 1.0, concurrency), timeout=120)
+                d0 = c.daemons[0]
+                tv0 = d0.tier.debug_vars() if d0.tier else {}
+                t0 = time.perf_counter()
+                rpcs, lat = c.run(
+                    drive(addr, cpays, seconds, concurrency),
+                    timeout=120,
+                )
+                wall = time.perf_counter() - t0
+                tv = d0.tier.debug_vars() if d0.tier else {}
+                checks = rpcs * 1000
+                extra = {
+                    "keyspace": keys,
+                    "hbm_slots": churn_dev.num_slots,
+                    "keyspace_over_slots": round(
+                        keys / churn_dev.num_slots, 1
+                    ),
+                }
+                if tv:
+                    from gubernator_tpu.runtime.metrics import (
+                        estimate_quantile,
+                    )
+
+                    lat_h = tv["promote_latency"]
+                    extra.update({
+                        "cold_residents": tv["cold_residents"],
+                        "cold_hits": tv["cold_hits"] - tv0.get(
+                            "cold_hits", 0
+                        ),
+                        "cold_hit_rate": round(
+                            (tv["cold_hits"] - tv0.get("cold_hits", 0))
+                            / max(checks, 1), 6
+                        ),
+                        "promotes": tv["promotes"] - tv0.get(
+                            "promotes", 0
+                        ),
+                        "demotes": tv["demotes"] - tv0.get(
+                            "demotes", 0
+                        ),
+                        "demotes_per_sec": round(
+                            (tv["demotes"] - tv0.get("demotes", 0))
+                            / wall, 1
+                        ),
+                        "capacity_drops": tv["capacity_drops"],
+                        "promote_p50_ms": round(estimate_quantile(
+                            lat_h["buckets"], lat_h["cumulative"], 0.5
+                        ) * 1e3, 3),
+                        "promote_p99_ms": round(estimate_quantile(
+                            lat_h["buckets"], lat_h["cumulative"], 0.99
+                        ) * 1e3, 3),
+                    })
+                fp = d0.fastpath
+                if fp is not None and fp.served:
+                    bf = sum(fp.blocking_fetches.values())
+                    extra["serve_mode"] = fp.effective_serve_mode
+                    extra["blocking_fetches_per_check"] = round(
+                        bf / fp.served, 6
+                    )
+                emit(f"churn_tiered_{keys}keys", checks, rpcs, lat,
+                     wall, extra)
+            finally:
+                c.stop()
+        except Exception as e:  # noqa: BLE001 — isolate config failures
+            print(json.dumps({
+                "config": "churn_tiered", "workload": workload,
+                "error": str(e),
+            }))
+
+    if workload and kind == "zipf":
+        try:
             zs = float(arg or "1.2")
             c = Cluster.start_with(
                 ["", "", ""], device=dev_cfg, conf_template=conf()
@@ -1226,7 +1335,10 @@ def main() -> None:
         help="extra skewed-workload config: zipf:<s> drives seeded "
         "zipfian key draws at a 3-daemon cluster and reports the "
         "per-owner share of applied checks alongside p50/p99 "
-        "(docs/hotkeys.md; empty disables)",
+        "(docs/hotkeys.md); churn:<keys> drives a keyspace far larger "
+        "than the HBM slot budget at a tier-enabled daemon and "
+        "reports cold-hit rate, promote latency, and demotion rate "
+        "(docs/tiering.md); empty disables",
     )
     ap.add_argument(
         "--mesh-shards", type=int, default=0,

@@ -95,6 +95,27 @@ def _node_lines(addr: str, v: Dict) -> List[str]:
                 f"{k}={n}" for k, n in shadow.items() if n
             )
         )
+    tier = v.get("tier")
+    if tier:
+        lat = tier.get("promote_latency") or {}
+        p99 = lat.get("p99_s")
+        p99_s = (
+            " promote_p99=%.2fms" % (p99 * 1e3)
+            if isinstance(p99, (int, float)) and p99 > 0 else ""
+        )
+        drops = tier.get("capacity_drops", 0)
+        lines.append(
+            "    tier: cold=%d/%d hits=%d promotes=%d demotes=%d%s%s"
+            % (
+                tier.get("cold_residents", 0),
+                tier.get("cold_capacity", 0),
+                tier.get("cold_hits", 0),
+                tier.get("promotes", 0),
+                tier.get("demotes", 0),
+                p99_s,
+                f" DROPS={drops}" if drops else "",
+            )
+        )
     return lines
 
 

@@ -453,6 +453,90 @@ def stats_config_from_env() -> StatsConfig:
         ) from None
 
 
+@dataclass
+class TierConfig:
+    """Guberberg — the two-tier key table (runtime/coldtier.py;
+    docs/tiering.md; no reference analog — the Go daemon's cache IS
+    host memory, so it never needed a second tier).
+
+    Off by default: the cold tier allocates `cold_capacity` rows of
+    host RAM up front, a budget the operator should size, not inherit.
+    When enabled, the TierManager demotes the coldest HBM rows once
+    occupancy crosses `high_water` (fraction of slots), draining to
+    `low_water` (hysteresis — the gap is the breathing room between
+    demote ticks); `demote_batch` bounds one demote_extract dispatch
+    (per shard on a mesh)."""
+
+    enabled: bool = False
+    # Cold-tier row budget (host RAM; rows beyond it are dropped).
+    cold_capacity: int = 1_000_000
+    # Occupancy fraction that starts demotion pressure.
+    high_water: float = 0.85
+    # Occupancy fraction demotion drains down to.
+    low_water: float = 0.70
+    # Rows per demote_extract dispatch (per shard on a mesh).
+    demote_batch: int = 256
+    # Watermark evaluation cadence in seconds.
+    interval_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.cold_capacity < 1:
+            raise ValueError(
+                f"tier cold_capacity must be >= 1, "
+                f"got {self.cold_capacity}"
+            )
+        if not 0.0 < self.high_water <= 1.0:
+            raise ValueError(
+                f"tier high_water must be in (0, 1], "
+                f"got {self.high_water}"
+            )
+        if not 0.0 < self.low_water <= 1.0:
+            raise ValueError(
+                f"tier low_water must be in (0, 1], "
+                f"got {self.low_water}"
+            )
+        if self.low_water >= self.high_water:
+            raise ValueError(
+                f"tier low_water ({self.low_water}) must be below "
+                f"high_water ({self.high_water}) — the gap is the "
+                f"demotion hysteresis"
+            )
+        if self.demote_batch < 1:
+            raise ValueError(
+                f"tier demote_batch must be >= 1, "
+                f"got {self.demote_batch}"
+            )
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"tier interval_s must be > 0, got {self.interval_s}"
+            )
+
+
+def tier_config_from_env() -> TierConfig:
+    """The tier plane's env parse: validation errors name the env
+    surface at startup (reject low >= high, capacity < 1) instead of
+    crashing a constructor later."""
+    try:
+        return TierConfig(
+            enabled=_env("GUBER_TIER_ENABLED", "false").lower()
+            in ("1", "true", "yes"),
+            cold_capacity=_env_int(
+                "GUBER_TIER_COLD_CAPACITY", 1_000_000
+            ),
+            high_water=float(_env("GUBER_TIER_HIGH_WATER", "0.85")),
+            low_water=float(_env("GUBER_TIER_LOW_WATER", "0.70")),
+            demote_batch=_env_int("GUBER_TIER_DEMOTE_BATCH", 256),
+            interval_s=_env_float_s("GUBER_TIER_INTERVAL", 1.0),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "tier env config (GUBER_TIER_ENABLED, "
+            "GUBER_TIER_COLD_CAPACITY, GUBER_TIER_HIGH_WATER, "
+            "GUBER_TIER_LOW_WATER, GUBER_TIER_DEMOTE_BATCH, "
+            f"GUBER_TIER_INTERVAL): {e}"
+        ) from None
+
+
 def peer_debounce_ms_from_env() -> int:
     """Discovery-update coalescing window (GUBER_PEER_DEBOUNCE_MS): an
     etcd/k8s watch storm delivering N membership events within the
@@ -626,6 +710,9 @@ class Config:
     # Gubstat state-plane introspection (runtime/gubstat.py;
     # docs/observability.md).
     stats: StatsConfig = field(default_factory=StatsConfig)
+    # Guberberg two-tier key table (runtime/coldtier.py;
+    # docs/tiering.md).
+    tier: TierConfig = field(default_factory=TierConfig)
 
 
 @dataclass
@@ -745,6 +832,9 @@ class DaemonConfig:
     # Gubstat state-plane introspection (runtime/gubstat.py;
     # docs/observability.md): census cadence, tenant top-K, /debug/key.
     stats: StatsConfig = field(default_factory=StatsConfig)
+    # Guberberg two-tier key table (runtime/coldtier.py;
+    # docs/tiering.md): HBM hot slots over a host-RAM cold tier.
+    tier: TierConfig = field(default_factory=TierConfig)
     # Discovery-update coalescing window in ms (GUBER_PEER_DEBOUNCE_MS):
     # rapid watch events within the window apply as ONE latest-wins
     # remap.  0 = apply every event (still serialized).
@@ -1141,6 +1231,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         lease=lease_config_from_env(),
         reshard=reshard_config_from_env(),
         stats=stats_config_from_env(),
+        tier=tier_config_from_env(),
         peer_debounce_ms=peer_debounce_ms_from_env(),
         reshard_drain_on_close=_env(
             "GUBER_RESHARD_DRAIN_ON_CLOSE", "false"

@@ -331,6 +331,10 @@ class Daemon:
         # per GUBER_STATS_ENABLED, closed before the fastpath (its ring
         # host jobs need the runner alive).
         self.stats_sampler = None
+        # Guberberg tier manager (runtime/coldtier.py): armed in
+        # start() per GUBER_TIER_ENABLED, closed before the fastpath
+        # (its promote jobs ride the ring's host-job lane).
+        self.tier = None
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._grpc_tls_proxy = None  # net.tls.TLSTerminatingProxy
         self._grpc_backend_dir: Optional[str] = None
@@ -373,6 +377,7 @@ class Daemon:
             hotkey=getattr(self.conf, "hotkey", None) or Config().hotkey,
             lease=getattr(self.conf, "lease", None) or Config().lease,
             stats=getattr(self.conf, "stats", None) or Config().stats,
+            tier=getattr(self.conf, "tier", None) or Config().tier,
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -424,6 +429,21 @@ class Daemon:
                 self.flightrec.extras["table"] = (
                     lambda: self.stats_sampler.last
                 )
+        if cfg.tier.enabled:
+            # Guberberg tier manager (runtime/coldtier.py;
+            # docs/tiering.md): host-RAM cold tier under the HBM table,
+            # promote-on-access through the ring's host-job lane,
+            # watermark demotion on its own worker thread.
+            from gubernator_tpu.runtime.coldtier import TierManager
+
+            self.tier = TierManager(
+                self.service,
+                cfg.tier,
+                fastpath=self.fastpath,
+                metrics=self.metrics,
+            )
+            self.service.tier = self.tier
+            self.tier.start()
 
         # gRPC server (daemon.go:101-126): both services on one listener.
         # 4MB recv cap: grpc-go's default, which reference peers assume.
@@ -574,6 +594,14 @@ class Daemon:
             # host job that needs the runner to drain it.
             await self.stats_sampler.close()
             self.stats_sampler = None
+        if self.tier is not None:
+            # Same ordering rule: the tier worker's promote/demote jobs
+            # ride the ring host-job lane, so stop it while the runner
+            # can still drain them.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.tier.close
+            )
+            self.tier = None
         if self.fastpath is not None:
             await self.fastpath.close()
             self.fastpath = None
@@ -825,6 +853,10 @@ class Daemon:
             # (occupancy, bucket fill, age/TTL histograms, shadow-plane
             # census) plus sampler health.
             out["table"] = self.stats_sampler.debug_vars()
+        if self.tier is not None:
+            # Guberberg tier ledger (docs/tiering.md): cold residents,
+            # promote/demote/cold-hit totals, promote latency histogram.
+            out["tier"] = self.tier.debug_vars()
         fp = self.fastpath
         if fp is not None:
             # Per-lane drain/pipeline counters (drains, overlap_drains,

@@ -228,6 +228,103 @@ migrate_inject = jax.jit(
 
 
 # --------------------------------------------------------------------------
+# Tiered table (docs/tiering.md): the demotion kernel.
+#
+# HBM slot count — not kernel throughput — is the binding constraint at
+# 100M+ keys, so the coldest residents spill to a host-RAM cold tier
+# (runtime/coldtier.py) and promote back on access via migrate_inject.
+# demote_extract is migrate_extract's per-row-atomicity shape pointed the
+# other way: instead of probing caller-named fingerprints, the DEVICE
+# picks the victims — the `batch` least-recently-touched live KIND_BUCKET
+# rows (the per-slot `touched` word every step already maintains for
+# bucket-local pseudo-LRU) — gathers their fields, and CLEARS the matched
+# slots in the same donated dispatch.  Between the gather and the clear
+# nothing else can touch the table, so a demoted row exists in exactly
+# one tier at every instant the backend lock is free.  Shadow-plane rows
+# (hot-mirror / lease-grant / degraded-shadow / handoff-shadow) carry
+# derived-key fingerprints the HOST enumerates; they ride the `protect`
+# list and are never demoted — their over-admission algebra assumes HBM
+# residency.  KIND_CACHED_RESP rows (GLOBAL broadcast cache) are skipped
+# device-side: they are a response cache, not bucket state.
+# --------------------------------------------------------------------------
+
+# Packed demote row layout: GATHER_ROW_FIELDS with the `found` word
+# replaced by the row's own key fingerprint (the caller did not name the
+# keys — the kernel picked them; 0 = inactive lane).  remaining_f rides
+# alongside as float64[batch], exactly the migrate_extract wire shape.
+DEMOTE_ROW_FIELDS = (
+    "key", "kind", "algo", "limit", "duration", "remaining", "t0",
+    "status", "burst", "expire_at",
+)
+
+
+def demote_extract_impl(
+    table: SlotTable,
+    protect: jax.Array,  # int64[M] shadow-plane fps; 0 = inactive
+    now: jax.Array,
+    ways: int = 8,
+    batch: int = 64,
+):
+    """Pick the `batch` coldest (least-recently-touched) live
+    KIND_BUCKET residents not on the `protect` list, gather their rows,
+    and CLEAR the matched slots (key=0, expire_at=0) in the same
+    donated step.  Returns (new_table, packed int64[10, batch] in
+    DEMOTE_ROW_FIELDS order, float64[batch] remaining_f); lanes past
+    the eligible population come back with key 0 and clear nothing."""
+    S = table.key.shape[0]
+    now = jnp.asarray(now, dtype=jnp.int64)
+    alive = (table.key != 0) & (table.expire_at > now)
+    eligible = alive & (table.kind == KIND_BUCKET)
+    protected = (
+        (table.key[:, None] == protect[None, :])
+        & (protect[None, :] != 0)
+    ).any(axis=1)
+    eligible = eligible & ~protected
+    # Victim score: last-touch stamp, ineligible slots pushed past any
+    # real timestamp so top_k(-score) yields the `batch` coldest
+    # eligible rows (the bucket-local pseudo-LRU word, applied
+    # table-wide).
+    big = jnp.iinfo(jnp.int64).max
+    score = jnp.where(eligible, table.touched, big)
+    neg, idx = jax.lax.top_k(-score, batch)
+    idx = idx.astype(jnp.int64)
+    sel = neg != -big
+    src = jnp.where(sel, idx, 0)
+
+    def g(arr):
+        return jnp.where(sel, arr[src], 0)
+
+    packed = jnp.stack([
+        g(table.key),
+        g(table.kind).astype(jnp.int64),
+        g(table.algo).astype(jnp.int64),
+        g(table.limit),
+        g(table.duration),
+        g(table.remaining),
+        g(table.t0),
+        g(table.status).astype(jnp.int64),
+        g(table.burst),
+        g(table.expire_at),
+    ])
+    rf = jnp.where(sel, table.remaining_f[src], 0.0)
+    # Clear exactly like migrate_extract: drop the fingerprint AND the
+    # expiry so the slot reads empty to every probe and first-choice to
+    # every victim claim.
+    tgt = jnp.where(sel, idx, S)
+    new_table = table._replace(
+        key=table.key.at[tgt].set(0, mode="drop"),
+        expire_at=table.expire_at.at[tgt].set(0, mode="drop"),
+    )
+    return new_table, packed, rf
+
+
+demote_extract = jax.jit(
+    demote_extract_impl, static_argnames=("ways", "batch"),
+    donate_argnums=(0,),
+)
+
+
+# --------------------------------------------------------------------------
 # Gubstat (docs/observability.md): the one-pass state census.
 #
 # The table is the thing HBM capacity binds at scale, yet until now it

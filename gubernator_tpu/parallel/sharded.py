@@ -267,6 +267,33 @@ def make_sharded_gather(mesh, ways: int):
     return jax.jit(sharded)
 
 
+def make_sharded_demote_extract(mesh, ways: int, batch: int):
+    """Sharded tier demotion (docs/tiering.md): every shard runs
+    ops/state.demote_extract_impl on its slice in the same donated
+    dispatch — each picks its own `batch` coldest eligible residents
+    (victim choice is slice-local, exactly like bucket-local pseudo-LRU
+    is bucket-local), gathers and clears them atomically.  The protect
+    fingerprint grid is replicated (P()): a shadow key only matches on
+    its home shard, so protection is exact.  Output carries the leading
+    [n] shard axis: packed int64[n, 10, batch] (DEMOTE_ROW_FIELDS
+    order), remaining_f float64[n, batch]."""
+    from gubernator_tpu.ops.state import demote_extract_impl
+
+    def _local(table: SlotTable, protect, now):
+        t2, packed, rf = demote_extract_impl(
+            table, protect, now, ways=ways, batch=batch
+        )
+        return t2, packed[None], rf[None]
+
+    sharded = _shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def make_sharded_table_stats(mesh, ways: int):
     """Sharded state census (docs/observability.md): every shard runs
     ops/state.table_stats_impl on its slice in one read-only pass and
@@ -988,5 +1015,64 @@ class MeshBackend(PersistenceHost):
 
         def fetch() -> "TableStats":
             return TableStats(*[np.asarray(a) for a in st])
+
+        return fetch
+
+    # -- tiered table (runtime/coldtier.py; docs/tiering.md) -------------
+    def occupancy_dispatch(self):
+        """Dispatch the cluster resident count under the lock; the
+        returned zero-arg fetch closure pulls the scalar off the runner
+        (DeviceBackend.occupancy_dispatch's contract)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            occ = jnp.sum(self.table.key != 0)
+
+        def fetch() -> int:
+            return int(np.asarray(occ))
+
+        return fetch
+
+    def demote_extract_dispatch(self, protect_fps: np.ndarray,
+                                batch: int):
+        """Sharded demote: each shard picks its own `batch` coldest
+        unprotected rows (victim choice is slice-local, like the
+        bucket-local pseudo-LRU), so one dispatch yields n_shards*batch
+        candidates.  Fetch flattens the per-shard planes back to the
+        DeviceBackend contract: (int64[10, n*batch], float64[n*batch]).
+        """
+        if not hasattr(self, "_demote_cache"):
+            self._demote_cache = {}
+        fn = self._demote_cache.get(batch)
+        if fn is None:
+            fn = make_sharded_demote_extract(
+                self.mesh, self.cfg.ways, batch
+            )
+            self._demote_cache[batch] = fn
+
+        now = np.int64(self.clock.millisecond_now())
+        fps = np.asarray(protect_fps, dtype=np.int64)
+        with self._lock:
+            self.table, packed, rf = fn(self.table, fps, now)
+
+        def fetch():
+            p = np.asarray(packed)  # [n, 10, batch]
+            r = np.asarray(rf)  # [n, batch]
+            return (
+                np.concatenate([p[s] for s in range(p.shape[0])],
+                               axis=1),
+                r.reshape(-1),
+            )
+
+        return fetch
+
+    def migrate_inject_dispatch(self, cols: Dict[str, np.ndarray]):
+        """Promote-path inject for the mesh: the generic
+        PersistenceHost.migrate_inject_rows path already serializes on
+        self._lock, so the whole probe+upsert+merge runs inside the
+        fetch closure on the tier manager's executor — off the ring
+        runner, same lock discipline, same (injected, merged) result."""
+        def fetch():
+            return self.migrate_inject_rows(cols)
 
         return fetch

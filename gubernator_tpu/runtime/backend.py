@@ -1144,6 +1144,122 @@ class DeviceBackend(PersistenceHost):
 
         return fetch
 
+    # -- tiered table (runtime/coldtier.py; docs/tiering.md) -------------
+    def occupancy_dispatch(self):
+        """Dispatch the resident-slot count under the lock and return a
+        zero-arg fetch closure — the tier manager's watermark read.
+        Split from occupancy() so a ring host job never blocks the
+        runner on the device->host scalar sync (the manager fetches on
+        its own executor, the gubstat discipline)."""
+        with self._lock:
+            occ = self.table.occupancy()
+
+        def fetch() -> int:
+            return int(np.asarray(occ))
+
+        return fetch
+
+    def demote_extract_dispatch(self, protect_fps: np.ndarray,
+                                batch: int):
+        """ONE donated ops/state.demote_extract dispatch under the lock:
+        the device picks the `batch` coldest unprotected live bucket
+        rows, gathers their fields, and clears the slots atomically.
+        Returns a zero-arg fetch closure yielding (packed int64
+        [10, batch] in DEMOTE_ROW_FIELDS order, float64[batch]
+        remaining_f) — dispatched on the ring runner, fetched off it."""
+        from gubernator_tpu.ops.state import demote_extract
+
+        now = np.int64(self.clock.millisecond_now())
+        fps = np.asarray(protect_fps, dtype=np.int64)
+        with self._lock:
+            self.table, packed, rf = demote_extract(
+                self.table, fps, now, ways=self.cfg.ways, batch=batch
+            )
+
+        def fetch():
+            return (
+                fetch_ravel([packed])[0].reshape(10, batch),
+                fetch_ravel([rf])[0],
+            )
+
+        return fetch
+
+    def migrate_inject_dispatch(self, cols: Dict[str, np.ndarray]):
+        """Dispatch-only form of migrate_inject_rows for the tier
+        promote path: the donated upsert-or-merge chunks go out under
+        the lock; the returned fetch closure resolves the (injected,
+        merged) counts off the runner thread.  Same kernel, same merge
+        algebra — only the host sync moves."""
+        from gubernator_tpu.ops.state import migrate_inject
+        from gubernator_tpu.ops.step import BucketRows
+
+        B = self.cfg.batch_size
+        now = np.int64(self.clock.millisecond_now())
+        n = len(cols["key_hash"])
+
+        # locate_slots resolves at most INSERT_ROUNDS (= 3) same-bucket
+        # insert conflicts per dispatch; a 4th contender ends transient
+        # and load_rows drops it — losing the row's consumed budget.
+        # Spread same-bucket rows across successive dispatches so every
+        # lane can claim a slot.
+        nb = self.cfg.num_slots // self.cfg.ways
+        fps = np.asarray(cols["key_hash"], dtype=np.int64)
+        bucket = fps.view(np.uint64) & np.uint64(nb - 1)
+        rank = np.zeros(n, dtype=np.int64)
+        seen: Dict[int, int] = {}
+        for i in range(n):
+            b = int(bucket[i])
+            rank[i] = seen.get(b, 0)
+            seen[b] = int(rank[i]) + 1
+        wave = rank // 3
+        chunks = []
+        for w in range(int(wave.max()) + 1 if n else 0):
+            widx = np.flatnonzero(wave == w)
+            for lo in range(0, len(widx), B):
+                chunks.append(widx[lo:lo + B])
+
+        resident_devs = []
+        actives = []
+        with self._lock:
+            for sel in chunks:
+                pad = B - len(sel)
+
+                def col(f, dt):
+                    return np.concatenate([
+                        np.asarray(cols[f], dtype=dt)[sel],
+                        np.zeros(pad, dtype=dt),
+                    ])
+
+                rows = BucketRows(
+                    key_hash=col("key_hash", np.int64),
+                    algo=col("algo", np.int32),
+                    limit=col("limit", np.int64),
+                    duration=col("duration", np.int64),
+                    remaining=col("remaining", np.int64),
+                    remaining_f=col("remaining_f", np.float64),
+                    t0=col("t0", np.int64),
+                    status=col("status", np.int32),
+                    burst=col("burst", np.int64),
+                    expire_at=col("expire_at", np.int64),
+                )
+                self.table, resident = migrate_inject(
+                    self.table, rows, now, ways=self.cfg.ways
+                )
+                resident_devs.append(resident)
+                actives.append(np.asarray(rows.key_hash) != 0)
+
+        def fetch():
+            if not resident_devs:
+                return 0, 0
+            injected = merged = 0
+            for res, act in zip(fetch_ravel(resident_devs), actives):
+                res = np.asarray(res)
+                injected += int((act & ~res).sum())
+                merged += int((act & res).sum())
+            return injected, merged
+
+        return fetch
+
 
 class Tally(NamedTuple):
     """Per-call metric increments (gubernator.go:59-113 counters)."""

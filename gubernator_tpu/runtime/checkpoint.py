@@ -71,10 +71,13 @@ class TableCheckpointer:
         step: int,
         keep: int = 3,
         sketch=None,  # SketchBackend — include the CMS state
+        coldtier=None,  # ColdTier — include the demoted-row store
     ) -> str:
-        """Checkpoint the table (and keymap when tracked; and the sketch
+        """Checkpoint the table (and keymap when tracked; the sketch
         tier's CMS state when passed — long-window abuse counters should
-        survive a restart); prunes old steps beyond `keep`."""
+        survive a restart; and the cold tier's resident rows when passed
+        — restart at 100M keys must not cold-start the cold tier);
+        prunes old steps beyond `keep`."""
         # Copy to host while holding the lock: the step functions donate the
         # table buffers, so a concurrent check() would delete the captured
         # device arrays mid-serialization ("Array has been deleted").
@@ -92,6 +95,10 @@ class TableCheckpointer:
                     "window_start": np.asarray(st.window_start),
                     "window_ms": np.asarray(st.window_ms),
                 }
+        if coldtier is not None:
+            # snapshot() compacts under coldtier._lock — the columnar
+            # MigratedRows layout, geometry-independent on restore.
+            payload["coldtier"] = dict(coldtier.snapshot())
         path = self._step_dir(step)
         self._ckptr.save(path, payload, force=True)
         if keymap is not None:
@@ -102,7 +109,7 @@ class TableCheckpointer:
         return path
 
     def restore(self, backend, step: Optional[int] = None,
-                sketch=None) -> int:
+                sketch=None, coldtier=None) -> int:
         """Restore the table in place; returns the restored step.  Works
         for DeviceBackend and MeshBackend alike — `_install_table` handles
         placement (sharded over the mesh for the latter; orbax stores the
@@ -110,7 +117,9 @@ class TableCheckpointer:
         (a checkpoint without sketch state leaves the live sketch
         untouched); the host window mirror follows the restored
         window_start, and the next check's rotation handles any elapsed
-        downtime exactly like elapsed uptime."""
+        downtime exactly like elapsed uptime.  With `coldtier`, the
+        demoted-row store is re-inserted row by row (capacity may have
+        changed; overflow rows are dropped and counted)."""
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -158,6 +167,13 @@ class TableCheckpointer:
                     sketch._win_start = int(
                         np.asarray(sk["window_start"])
                     )
+        if coldtier is not None and "coldtier" in payload:
+            rows = {
+                f: np.asarray(v)
+                for f, v in payload["coldtier"].items()
+            }
+            n = coldtier.restore(rows)
+            log.info("restored %d cold-tier rows", n)
         km_path = os.path.join(path, "keymap.json")
         if os.path.exists(km_path) and backend._keymap is not None:
             with open(km_path) as f:
@@ -186,10 +202,12 @@ class PeriodicCheckpointLoop:
         interval_s: float = 30.0,
         keep: int = 3,
         sketch=None,  # SketchBackend — snapshot the CMS state too
+        coldtier=None,  # ColdTier — snapshot the demoted rows too
     ) -> None:
         self.ckptr = TableCheckpointer(directory)
         self.backend = backend
         self.sketch = sketch
+        self.coldtier = coldtier
         self.interval_s = interval_s
         self.keep = keep
         self._task: Optional[asyncio.Task] = None
@@ -220,7 +238,8 @@ class PeriodicCheckpointLoop:
             await loop.run_in_executor(
                 None,
                 lambda: self.ckptr.save(
-                    self.backend, step, self.keep, sketch=self.sketch
+                    self.backend, step, self.keep, sketch=self.sketch,
+                    coldtier=self.coldtier,
                 ),
             )
         except Exception as e:  # noqa: BLE001
@@ -239,12 +258,16 @@ class OrbaxLoader(Loader):
         self.ckptr = TableCheckpointer(directory)
         self._backend: Optional[DeviceBackend] = None
         self._sketch = None
+        self._coldtier = None
 
-    def attach(self, backend: DeviceBackend, sketch=None) -> None:
+    def attach(self, backend: DeviceBackend, sketch=None,
+               coldtier=None) -> None:
         self._backend = backend
         self._sketch = sketch
+        self._coldtier = coldtier
         try:
-            self.ckptr.restore(backend, sketch=sketch)
+            self.ckptr.restore(backend, sketch=sketch,
+                               coldtier=coldtier)
         except FileNotFoundError:
             pass
 
@@ -254,4 +277,5 @@ class OrbaxLoader(Loader):
     def save(self, items: Iterator[CacheItem]) -> None:
         if self._backend is not None:
             step = (self.ckptr.latest_step() or 0) + 1
-            self.ckptr.save(self._backend, step, sketch=self._sketch)
+            self.ckptr.save(self._backend, step, sketch=self._sketch,
+                            coldtier=self._coldtier)

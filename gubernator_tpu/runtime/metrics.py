@@ -538,6 +538,45 @@ class Metrics:
             registry=r,
         )
 
+        # -- Guberberg two-tier key table (runtime/coldtier.py) -----------
+        self.tier_cold_residents = Gauge(
+            "gubernator_tier_cold_residents",
+            "Rows resident in the host-RAM cold tier (demoted from HBM, "
+            "promotable on access).",
+            registry=r,
+        )
+        self.tier_capacity_drops = Gauge(
+            "gubernator_tier_capacity_drops",
+            "Demoted rows dropped because the cold tier was at its "
+            "configured capacity — each costs at most one bounded "
+            "over-admission window (docs/tiering.md).",
+            registry=r,
+        )
+        self.tier_promotes = Counter(
+            "gubernator_tier_promotes_total",
+            "Cold-tier rows promoted back into the device table.",
+            registry=r,
+        )
+        self.tier_demotes = Counter(
+            "gubernator_tier_demotes_total",
+            "Device-table rows demoted to the cold tier by watermark "
+            "pressure.",
+            registry=r,
+        )
+        self.tier_cold_hits = Counter(
+            "gubernator_tier_cold_hits_total",
+            "Served keys found cold-resident (each schedules a "
+            "promote; the serving round itself used a fresh row).",
+            registry=r,
+        )
+        self.tier_promote_latency = Gauge(
+            "gubernator_tier_promote_latency",
+            "Cumulative promote-latency histogram on the shared "
+            "LATENCY_BUCKETS (seconds from cold hit to merged inject).",
+            ["le"],
+            registry=r,
+        )
+
         # -- gubstat: per-tenant admission accounting ---------------------
         self.tenant_hits = Gauge(
             "gubernator_tenant_hits",

@@ -224,6 +224,10 @@ class Service:
             )
             self.hotkeys.pressure_fn = self._owner_pressure_of
             self.hotkeys.on_demote = self._on_hot_demote
+        # Guberberg tier manager (runtime/coldtier.py; docs/tiering.md):
+        # the daemon arms it when GUBER_TIER_ENABLED; note_traffic feeds
+        # its promote-on-access path.
+        self.tier = None
         # fp -> RESET_REMAINING req that drops the local mirror slot
         # when its key demotes (the shadow-drop discipline).
         self._mirror_resets: Dict[int, RateLimitReq] = {}
@@ -600,6 +604,12 @@ class Service:
         hk = self.hotkeys
         if hk is not None and len(key_hashes):
             hk.observe(key_hashes, hits)
+        tier = self.tier
+        if tier is not None and len(key_hashes):
+            # Promote-on-access (docs/tiering.md): a served key that is
+            # cold-resident schedules a FIFO host-job inject; THIS
+            # batch was already answered from whatever the device had.
+            tier.note_access(key_hashes, hits)
 
     def _peer_by_fp(self, fp: int) -> Optional[PeerClient]:
         """Owning peer for a device fingerprint — xx rings only, where
@@ -945,7 +955,7 @@ class Service:
 
         reqs = self._strip_sketch_global(reqs)
 
-        if self.hotkeys is not None:
+        if self.hotkeys is not None or self.tier is not None:
             valid = [r for r in reqs if r.unique_key and r.name]
             if valid:
                 from gubernator_tpu.core.hashing import bulk_key_hash64
@@ -1646,7 +1656,7 @@ class Service:
         # client's original bytes — re-strip here so a GLOBAL+sketch
         # request never queues an exact-table broadcast for a sketch key.
         reqs = self._strip_sketch_global(reqs)
-        if self.hotkeys is not None:
+        if self.hotkeys is not None or self.tier is not None:
             # Owner-side detection: forwarded traffic is exactly the
             # load a pressured owner needs to see per key.
             valid = [r for r in reqs if r.unique_key and r.name]

@@ -2,9 +2,9 @@
 cluster (the compressed version of tests/test_chaos.py +
 tests/test_hotkey.py).
 
-Scenarios (--scenario storm|hotkey|lease|reshard|all; default storm —
-the original job; CI runs hotkey, lease and reshard as their own
-required steps):
+Scenarios (--scenario storm|hotkey|lease|reshard|coldstorm|all;
+default storm — the original job; CI runs hotkey, lease, reshard and
+coldstorm as their own required steps):
 
   storm   a seeded storm of client/server faults (>=30% of peer RPCs
           fail) with breakers + `local_shadow` degraded mode armed:
@@ -43,6 +43,16 @@ required steps):
           reset preserved), the old owner's slots are purged (no daemon
           serves from an orphaned slot), and a graceful LEAVE drains
           every row back to the survivors with counters conserved.
+
+  coldstorm the Guberberg two-tier table under an 8x-slots keyspace
+          (docs/tiering.md): one tier-enabled daemon with 1024 HBM
+          slots serves 8192 keys; the watermark loop demotes, zipfian
+          reuse drives cold hits + promote-on-access, and the merged
+          /debug/vars ledger proves admission within the documented
+          bound (allowed <= limit x (keys + demote cycles)).  Then
+          kill + restart: the checkpoint restores BOTH tiers (cold
+          residents + HBM occupancy conserved) and an exhausted key
+          stays denied — no limit reset.
 
 On any failure each daemon's flight recorder dumps its ring to
 GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
@@ -912,12 +922,227 @@ def reshard_scenario(seed: int) -> None:
         cluster.stop()
 
 
+def coldstorm_scenario(seed: int) -> None:
+    """The Guberberg tier storm (docs/tiering.md): a keyspace 8x the
+    HBM slot budget through a live tier-enabled daemon.  Asserts the
+    documented over-admission bound from the merged /debug/vars ledger
+    (allowed <= limit x (keys + demote/promote cycles)), that the tier
+    actually cycled (demotes, cold hits, promotes all nonzero), then
+    kill + restart: the checkpoint must restore BOTH tiers — cold
+    residents conserved, HBM occupancy restored, and an exhausted key
+    still denied (no limit reset across the restart)."""
+    import shutil
+    import tempfile
+
+    from gubernator_tpu.cli import gubtop
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import (
+        DaemonConfig,
+        DeviceConfig,
+        TierConfig,
+    )
+    from gubernator_tpu.core.types import RateLimitReq, Status
+    from gubernator_tpu.runtime.checkpoint import TableCheckpointer
+    from gubernator_tpu.testing import Cluster
+    from gubernator_tpu.testing.chaos import zipf_keys
+
+    SLOTS = 1024
+    NKEYS = SLOTS * 8
+    CLIMIT = 50
+    CDUR = 300_000  # outlives the smoke — nothing expires mid-run
+    dev = DeviceConfig(num_slots=SLOTS, ways=8, batch_size=512)
+
+    def tiered_conf() -> DaemonConfig:
+        return DaemonConfig(
+            tier=TierConfig(
+                enabled=True, cold_capacity=NKEYS * 2,
+                high_water=0.60, low_water=0.40,
+                demote_batch=256, interval_s=0.15,
+            ),
+            flightrec=True,
+            flightrec_dir=os.environ.get(
+                "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+            ),
+        )
+
+    def drive(cl, keys, hits=1):
+        """One admission sweep; returns per-key OK counts."""
+        ok = {}
+        for lo in range(0, len(keys), 500):
+            chunk = keys[lo:lo + 500]
+            resp = cl.get_rate_limits([
+                RateLimitReq(
+                    name="coldstorm", unique_key=k, hits=hits,
+                    limit=CLIMIT, duration=CDUR,
+                )
+                for k in chunk
+            ], timeout=60)
+            for k, r in zip(chunk, resp):
+                if r.error == "" and r.status == Status.UNDER_LIMIT:
+                    ok[k] = ok.get(k, 0) + hits
+        return ok
+
+    def settle(d, deadline_s=15.0):
+        """Drain queued promotes so the counters/cold census are
+        stable before we assert on them (drain_promotes_sync is the
+        TierManager's test/smoke entry point)."""
+        t1 = time.monotonic() + deadline_s
+        while time.monotonic() < t1:
+            tm = d.tier
+            if tm is None or tm.drain_promotes_sync() == 0:
+                return
+            time.sleep(0.05)
+
+    ckdir = tempfile.mkdtemp(prefix="coldstorm-ck-")
+    cluster = Cluster.start_with(
+        [""], device=dev, conf_template=tiered_conf()
+    )
+    try:
+        d0 = cluster.daemons[0]
+        keys = [f"c{i}" for i in range(NKEYS)]
+        ok = {k: 0 for k in keys}
+        cl = V1Client(cluster.addresses()[0])
+        try:
+            # Pass 1: the full keyspace once — 8x the slot budget
+            # cannot be HBM-resident, so the watermark loop must cycle
+            # rows through the cold tier for the daemon to keep
+            # serving.
+            for k, n in drive(cl, keys).items():
+                ok[k] += n
+            # Pass 2: seeded zipfian reuse — hot ranks re-hit keys the
+            # watermark already demoted, driving cold hits + promotes
+            # (and pushing hot keys past one limit window, so the
+            # over-admission bound below is load-bearing, not slack).
+            for _round in range(6):
+                draws = zipf_keys(seed + _round, 1.3, 2000, NKEYS)
+                reuse = [f"c{i}" for i in sorted(set(draws))]
+                for k, n in drive(cl, reuse).items():
+                    ok[k] += n
+                time.sleep(0.2)  # let watermark ticks interleave
+            settle(d0)
+
+            # The merged production ledger (/debug/vars via gubtop),
+            # never test internals.
+            scrape = gubtop.scrape(d0.http_address)
+            assert "error" not in scrape, scrape
+            tier = scrape.get("tier") or {}
+            assert tier, "/debug/vars has no tier block"
+            assert tier["demotes"] > 0, (
+                f"no demotions under 8x slot pressure: {tier}"
+            )
+            assert tier["cold_hits"] > 0 and tier["promotes"] > 0, (
+                f"zipfian reuse never hit the cold tier: {tier}"
+            )
+            tenant = _merged_tenant(cluster.daemons, "coldstorm")
+            allowed = tenant["allowed"]
+            client_ok = sum(ok.values())
+            served = sum(1 for k in keys if ok[k] > 0)
+            assert served >= NKEYS * 0.95, (
+                f"only {served}/{NKEYS} keys admitted at least once"
+            )
+            # docs/tiering.md bound: each demote/promote cycle widens a
+            # key's admission by at most ONE limit window, so
+            # cluster-wide: allowed <= limit x (keys + cycles), and
+            # every cycle begins with a demotion.
+            bound = CLIMIT * (NKEYS + tier["demotes"])
+            assert allowed <= bound, (
+                f"tier over-admission past the documented bound: "
+                f"allowed={allowed} > {bound} "
+                f"(= {CLIMIT} x ({NKEYS} keys + {tier['demotes']} "
+                f"demotes))"
+            )
+            assert allowed >= client_ok, (
+                f"ledger allowed={allowed} < client-observed "
+                f"{client_ok}"
+            )
+
+            # Freeze the watermark loop so the exhaust -> save window
+            # is race-free (close() is idempotent; the daemon's own
+            # shutdown calls it again), then exhaust one key
+            # completely and checkpoint BOTH tiers.
+            settle(d0)
+            d0.tier.close()
+            probe = "c0"
+            denied = False
+            for _ in range(2 * CLIMIT + 2):
+                r = cl.get_rate_limits([RateLimitReq(
+                    name="coldstorm", unique_key=probe, hits=1,
+                    limit=CLIMIT, duration=CDUR,
+                )], timeout=60)[0]
+                assert r.error == "", r
+                if r.status == Status.OVER_LIMIT:
+                    denied = True
+                    break
+            assert denied, "probe key never exhausted pre-restart"
+            cold_before = d0.tier.cold.residents()
+            occ_before = d0.service.backend.occupancy()
+            assert cold_before > 0, "nothing cold-resident at save"
+            ck = TableCheckpointer(ckdir)
+            ck.save(d0.service.backend, step=1, coldtier=d0.tier.cold)
+        finally:
+            cl.close()
+    except BaseException:
+        _dump_flightrec(cluster, "coldstorm-failure")
+        cluster.stop()
+        shutil.rmtree(ckdir, ignore_errors=True)
+        raise
+    else:
+        cluster.stop()  # the kill
+
+    # Restart: a fresh daemon restores both tiers from the checkpoint.
+    cluster = Cluster.start_with(
+        [""], device=dev, conf_template=tiered_conf()
+    )
+    try:
+        d1 = cluster.daemons[0]
+        ck = TableCheckpointer(ckdir)
+        ck.restore(d1.service.backend, coldtier=d1.tier.cold)
+        cold_after = d1.tier.cold.residents()
+        occ_after = d1.service.backend.occupancy()
+        assert cold_after == cold_before, (
+            f"cold tier not conserved across restart: "
+            f"{cold_before} -> {cold_after}"
+        )
+        assert occ_after == occ_before, (
+            f"HBM tier not conserved across restart: "
+            f"{occ_before} -> {occ_after}"
+        )
+        cl = V1Client(cluster.addresses()[0])
+        try:
+            r = cl.get_rate_limits([RateLimitReq(
+                name="coldstorm", unique_key="c0", hits=1,
+                limit=CLIMIT, duration=CDUR,
+            )], timeout=60)[0]
+            assert r.status == Status.OVER_LIMIT, (
+                f"restart reset the limit: exhausted key admitted "
+                f"again ({r})"
+            )
+        finally:
+            cl.close()
+        print(
+            f"coldstorm smoke OK: seed={seed} keyspace={NKEYS} "
+            f"(8x {SLOTS} slots), served={served}, "
+            f"demotes={tier['demotes']} promotes={tier['promotes']} "
+            f"cold_hits={tier['cold_hits']}, allowed={allowed} <= "
+            f"bound={bound}, restart conserved "
+            f"(cold={cold_after}, hbm={occ_after}), no limit reset"
+        )
+    except BaseException:
+        _dump_flightrec(cluster, "coldstorm-restart-failure")
+        raise
+    finally:
+        cluster.stop()
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument(
         "--scenario",
-        choices=("storm", "hotkey", "lease", "reshard", "all"),
+        choices=(
+            "storm", "hotkey", "lease", "reshard", "coldstorm", "all"
+        ),
         default="storm",
     )
     args = ap.parse_args()
@@ -929,6 +1154,8 @@ def main() -> None:
         lease_scenario(args.seed)
     if args.scenario in ("reshard", "all"):
         reshard_scenario(args.seed)
+    if args.scenario in ("coldstorm", "all"):
+        coldstorm_scenario(args.seed)
 
 
 if __name__ == "__main__":

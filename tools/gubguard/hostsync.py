@@ -35,6 +35,12 @@ ALLOWED_SUFFIXES = (
     # calls are host->host (docs/observability.md).
     "runtime/gubstat.py",
     "runtime/checkpoint.py",
+    # The tier manager's fetches run on its own worker thread through
+    # the ring's host-job lane (docs/tiering.md), and the cold store
+    # itself is pure host numpy — its np.asarray calls are host->host;
+    # the request-path touch (note_access) is a set probe, no device
+    # arrays in reach.
+    "runtime/coldtier.py",
     "runtime/sketch_backend.py",
     "runtime/store.py",
     "parallel/sharded.py",

@@ -47,6 +47,7 @@ CLASS_LOCK_MAP = {
     ("LeaseManager", "_lock"): "lease._lock",
     ("_LeaseTable", "_lock"): "lease.client._lock",
     ("ReshardManager", "_lock"): "reshard._lock",
+    ("ColdTier", "_lock"): "coldtier._lock",
     ("TenantAccounting", "_lock"): "gubstat._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
@@ -70,6 +71,9 @@ VAR_ALIAS = {
     "fr": "flightrec",
     "tenants": "gubstat",
     "ta": "gubstat",
+    "cold": "coldtier",
+    "coldtier": "coldtier",
+    "ct": "coldtier",
 }
 # Declared global acquisition order (lower rank acquired first).
 # flightrec._lock ranks LAST: any layer may record into the flight
@@ -93,6 +97,13 @@ RANK = {
     "engine._lock": 30,
     "sketch._lock": 40,
     "store._lock": 50,
+    # coldtier._lock (runtime/coldtier.py cold-store rows + member
+    # set) is a leaf taken alone: the request path's note_access probes
+    # membership holding nothing, the tier worker's put/pop run between
+    # (never across) device dispatches, and the store takes no other
+    # lock while held.  Ranked before the routing-plane tails so a
+    # future caller holding it cannot legally take backend/engine locks.
+    "coldtier._lock": 54,
     # hotkey._lock (runtime/hotkey.py window/hot-set state) is acquired
     # from routing paths holding nothing and takes nothing while held
     # (pressure_fn reads lock-free peer/flightrec attrs; flight-recorder

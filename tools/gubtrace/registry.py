@@ -719,6 +719,11 @@ def specs() -> List[KernelSpec]:
 
         return make
 
+    def demote_factory():
+        import gubernator_tpu.parallel.sharded as sh
+
+        return sh.make_sharded_demote_extract(_mesh(), WAYS, MESH_B)
+
     return [
         # -- ops/step.py: the exact-tier table kernels ------------------
         _step_spec(
@@ -774,6 +779,15 @@ def specs() -> List[KernelSpec]:
             _TABLE_COUNTERS + (".key_hash", ".limit", ".duration", "[2]"),
             {"to_f64": 1}, donated=12,
         ),
+        # -- ops/state.py: the tier demotion kernel (docs/tiering.md) --
+        # Same gather+clear atomicity shape as migrate_extract, but the
+        # DEVICE names the victims: the B here sizes the replicated
+        # protect grid; the packed batch rides the static default.
+        _migrate_spec(
+            "demote_extract", "demote_extract", "demote_extract_impl",
+            lambda B: (np.zeros(B, np.int64),),
+            _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=12,
+        ),
         # -- ops/state.py: the gubstat state census ---------------------
         _table_stats_spec(),
         # -- ops/ring.py: the ring-fed device loop ----------------------
@@ -817,6 +831,11 @@ def specs() -> List[KernelSpec]:
             "sharded_gather", f_step("sharded_gather"),
             lambda: (_hash_grid(),),
             _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=0,
+        ),
+        _mesh_spec(
+            "sharded_demote_extract", demote_factory,
+            lambda: (np.zeros(8, np.int64),),
+            _TABLE_COUNTERS + ("[1]", "[2]"), {}, donated=12,
         ),
         _mesh_spec(
             "sharded_table_stats", f_step("sharded_table_stats"),
