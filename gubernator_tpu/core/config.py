@@ -971,6 +971,23 @@ def gubtrace_dump_dir_from_env() -> str:
     return _env("GUBTRACE_DUMP_DIR", "gubtrace-dumps")
 
 
+def gubproof_dump_dir_from_env() -> str:
+    """Where `python -m tools.gubproof` writes counterexample chaos
+    plans (GUBER_CHAOS_PLAN JSON, replayable by testing/chaos.py; CI
+    uploads the directory as the failure artifact).  Same discipline
+    as gubtrace_dump_dir_from_env."""
+    return _env("GUBPROOF_DUMP_DIR", "gubproof-dumps")
+
+
+def gubproof_depth_from_env() -> Optional[int]:
+    """BFS depth cap for the gubproof explorer; 0 / unset = unbounded.
+    The pinned small scopes close unaided, so a cap only exists to
+    bound runaway exploration when a model is edited — an insufficient
+    cap is itself reported as an error, never a silent pass."""
+    d = _env_int("GUBPROOF_DEPTH", 0)
+    return None if d <= 0 else d
+
+
 def fastpath_sparse_from_env() -> int:
     """The sparse-overlap drain knob, parsed/validated exactly as the
     daemon does — the public entry for harnesses (bench_e2e) that build

@@ -40,6 +40,10 @@ gubguard lock ranking to order, nothing for raceguard to invert.
 
 All time is injected (`clock`, default time.monotonic) and all jitter
 is injected (`rng`), so tests drive the schedule deterministically.
+
+Protocol spec: tools/gubproof/specs/breaker.json — every `state` write
+site below must map to a declared edge (checked by `python -m
+tools.gubproof`, which also model-checks the probe-admission bound).
 """
 from __future__ import annotations
 

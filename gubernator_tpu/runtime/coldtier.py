@@ -36,6 +36,11 @@ Locking: ``coldtier._lock`` ranks BELOW every request-path lock
 (tools/gubguard/lockorder.py rank 54) — it is only ever taken alone,
 never across device work, and the request path's only use is the
 O(batch) membership probe in ``note_access``.
+
+Protocol spec: tools/gubproof/specs/tier.json — residency moves are
+tracked by their ColdTier calls (put_rows / pop_rows / prune_expired);
+each call site must map to a declared hot/cold/dropped edge and the
+explorer reproduces the per-cycle admission bound exactly.
 """
 
 from __future__ import annotations
@@ -44,7 +49,7 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -256,10 +261,10 @@ class TierManager:
 
     def __init__(
         self,
-        service,
-        cfg,
-        fastpath=None,
-        metrics=None,
+        service: Any,
+        cfg: Any,
+        fastpath: Optional[Any] = None,
+        metrics: Optional[Any] = None,
     ) -> None:
         from gubernator_tpu.runtime.metrics import LATENCY_BUCKETS
         from gubernator_tpu.runtime.sketch_backend import HostCMS
@@ -372,7 +377,7 @@ class TierManager:
                     # shutdown; pressure returns next tick.
                     log.debug("demote tick failed", exc_info=True)
 
-    def _run_job(self, fn):
+    def _run_job(self, fn: Callable[[], Any]) -> Any:
         """Run a dispatch callable FIFO with the serving rounds when a
         ring is live (never on the request path, never blocking the
         runner beyond the dispatch itself); direct call otherwise.

@@ -28,6 +28,11 @@ Threading: `_lock` guards only the holder dict (never held across an
 await or any device work); registered in the gubguard lock-order
 ranking (tools/gubguard/lockorder.py) alongside hotkey._lock — taken
 holding nothing, takes nothing while held.
+
+Protocol spec: tools/gubproof/specs/lease.json — every write to a
+holder record or the key table must map to a declared lifecycle edge
+(grant -> renew -> reconcile -> release/expire), and the explorer
+reproduces the `limit x (1 + max_holders x fraction)` bound exactly.
 """
 from __future__ import annotations
 

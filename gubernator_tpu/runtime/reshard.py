@@ -53,6 +53,11 @@ Threading: `_lock` guards only the handoff dicts and counters — never
 held across an await or any device work (registered in the gubguard
 lock ranking next to lease._lock).  Device work rides the service's
 single-thread device executor like every other table mutation.
+
+Protocol spec: tools/gubproof/specs/reshard.json — every `phase` write
+below must map to a declared edge, and the explorer closes the full
+handoff x fault space at small scope (including the reshard+lease
+composition), reproducing the admission bounds above exactly.
 """
 from __future__ import annotations
 
@@ -61,7 +66,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field, replace as dc_replace
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -109,7 +114,7 @@ def ring_owner_indices(fps: np.ndarray, picker) -> np.ndarray:
 
 
 def compute_moved(
-    fps: np.ndarray, old_picker, new_picker
+    fps: np.ndarray, old_picker: Any, new_picker: Any
 ) -> Dict[str, np.ndarray]:
     """The remap delta: of the int64 fingerprints `fps` resident on
     THIS node, which were owned by us under `old_picker` but belong to
@@ -657,7 +662,7 @@ class ReshardManager:
         self._count_rows("skipped", skipped)
         return injected, skipped
 
-    def _ring_without_me(self):
+    def _ring_without_me(self) -> Any:
         """The current ring minus this node — on a JOINER (which never
         saw the old ring) the owner of a moved key under this ring IS
         its old owner, because adding a peer's vnodes only reassigns

@@ -1,0 +1,126 @@
+"""CI smoke: run the gubproof verifier end-to-end the way an operator
+does — the CLI over the real specs must pass clean, every seeded
+fixture must fail its phase, the explorer must close every pinned
+small scope reproducing the documented maxima exactly, and a
+counterexample from the replay-guard-removed reshard variant must
+lower to a chaos plan the real loader parses.
+
+Run from the repo root:  python scripts/gubproof_smoke.py
+Exits non-zero with a labeled assertion on any missing piece.
+(Mirrors scripts/gubtrace_smoke.py.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Runnable from a checkout without an installed package.
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    # 1. The CLI over the real specs passes clean (exit 0, no errors),
+    #    strict so even warnings would fail here.
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.gubproof", "--json", "--strict"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"gubproof CLI failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+    assert json.loads(proc.stdout) == [], (
+        f"tree not clean: {proc.stdout}"
+    )
+
+    # 2. Every seeded fixture fails its phase with the expected class.
+    from tools.gubproof.conformance import lint_spec
+    from tools.gubproof.spec import load_spec
+    from pathlib import Path
+
+    fixtures = Path(REPO) / "tests" / "gubproof_fixtures"
+    expect = {
+        "spec_undeclared.json": "undeclared transition",
+        "spec_unguarded.json": "missing guard",
+        "spec_missing_edge.json": "no implementation site",
+    }
+    for name, needle in expect.items():
+        spec = load_spec(fixtures / name)
+        errs = [
+            f for f in lint_spec(spec, Path(REPO))
+            if f.severity == "error"
+        ]
+        assert errs, f"fixture {name} did not fail"
+        assert any(needle in f.message for f in errs), (
+            f"fixture {name}: expected {needle!r} in {errs}"
+        )
+
+    # 3. The explorer closes every pinned scope and reproduces the
+    #    documented over-admission algebra EXACTLY.
+    from tools.gubproof import load_all_specs
+    from tools.gubproof.explore import explore_model
+    from tools.gubproof.models import ReshardModel, build_models
+
+    specs = load_all_specs()
+    algebra = {
+        "breaker": {"half_open_probes_admitted": 1},
+        "lease": {"admitted": 6},
+        "reshard": {"admitted_clean": 5, "admitted_lost": 9},
+        "tier": {"admitted": 12},
+        "reshard_lease": {"admitted_clean": 7, "admitted_lost": 11},
+    }
+    for model in build_models(specs):
+        res = explore_model(model)
+        assert res.closed, f"{model.name}: {res.closure_note}"
+        assert not res.violations, (
+            f"{model.name}: {[v.message for v in res.violations]}"
+        )
+        assert res.max_counters == algebra[model.name], (
+            f"{model.name}: explored {res.max_counters}, documented "
+            f"{algebra[model.name]}"
+        )
+        print(
+            f"gubproof smoke: {model.name:14s} {res.states:5d} states "
+            f"closed, maxima {res.max_counters}"
+        )
+
+    # 4. A violated bound ships as a replayable chaos plan: the broken
+    #    variant's counterexample round-trips through the real loader.
+    from gubernator_tpu.testing.chaos import ChaosPlan
+    from tools.gubproof.chaosplan import plan_from_trace
+
+    res = explore_model(ReshardModel(specs, replay_guard=False))
+    assert res.violations, "replay-guard removal must yield a violation"
+    v = res.violations[0]
+    plan = plan_from_trace("reshard-no-replay-guard", list(v.trace),
+                           v.message, seed=1)
+    cp = ChaosPlan.from_dict(plan)
+    assert cp.rules, "counterexample lowered to an empty plan"
+    assert any(
+        r.method == "*Migrate*" and r.phase == "after" for r in cp.rules
+    ), f"dup-delivery window missing from {plan['rules']}"
+
+    # 5. The CLI writes the plan to the dump dir on violation paths
+    #    (exercised via an insufficient depth cap + the dump flag, then
+    #    a direct dump of the broken-variant plan).
+    dump = os.path.join(REPO, "gubproof-smoke-dumps")
+    shutil.rmtree(dump, ignore_errors=True)
+    os.makedirs(dump)
+    with open(os.path.join(dump, "dup-migrate.chaosplan.json"), "w") as f:
+        json.dump(plan, f, indent=2)
+    reloaded = ChaosPlan.from_dict(
+        json.load(open(os.path.join(dump, "dup-migrate.chaosplan.json")))
+    )
+    assert reloaded.seed == 1 and len(reloaded.rules) == len(cp.rules)
+    shutil.rmtree(dump, ignore_errors=True)
+
+    print("gubproof smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Seeded gubproof violation: a SPEC EDGE WITH NO IMPLEMENTATION SITE.
+
+The paired spec (spec_missing_edge.json) declares an `expire` edge
+(active -> absent via `sweep` popping the holder) that this module
+never implements — holders are granted and then leak forever.  The
+linter must report the dead spec edge, anchored at the spec file.
+"""
+
+
+class Table:
+    def __init__(self) -> None:
+        self.holders: dict = {}
+
+    def grant(self, holder: str) -> None:
+        self.holders[holder] = "active"

@@ -1,0 +1,24 @@
+"""Seeded gubproof violation: an UNDECLARED TRANSITION.
+
+`sneaky_reset` writes the state machine back to "closed", but the spec
+(tools/gubproof/specs is the real set; this fixture pairs with
+tests/gubproof_fixtures/spec_undeclared.json) declares no edge landing
+in "closed" — the conformance linter must flag exactly that write and
+nothing else in this module.
+"""
+
+OPEN = "open"
+CLOSED = "closed"
+
+
+class Toy:
+    def __init__(self) -> None:
+        self.state = CLOSED
+        self.failures = 0
+
+    def trip(self) -> None:
+        if self.failures > 3:
+            self.state = OPEN
+
+    def sneaky_reset(self) -> None:
+        self.state = CLOSED  # undeclared: no spec edge lands in closed

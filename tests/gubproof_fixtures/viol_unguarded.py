@@ -1,0 +1,18 @@
+"""Seeded gubproof violation: a MISSING GUARD.
+
+`finish` performs the declared cutover->released write, but the spec
+edge requires the guard term `outcome` to appear in a branch test of
+the site — here the write is unconditional, so the linter must report
+a missing guard (pairs with spec_unguarded.json).
+"""
+
+CUTOVER = "cutover"
+RELEASED = "released"
+
+
+class Handoff:
+    def __init__(self) -> None:
+        self.phase = CUTOVER
+
+    def finish(self) -> None:
+        self.phase = RELEASED  # unguarded: no `outcome` branch test

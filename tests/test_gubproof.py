@@ -1,0 +1,232 @@
+"""gubproof self-tests: the spec loader validates, the conformance
+linter is green on the real protocol modules and catches each seeded
+fixture, the explorer closes every pinned small scope reproducing the
+documented over-admission maxima EXACTLY, the replay-guard-removed
+reshard variant yields a counterexample that round-trips into a
+replayable chaos plan, and the CLI flags behave.
+
+Fixtures live in tests/gubproof_fixtures/ — each is a toy module plus
+its own mini spec JSON; they are parsed as source, never imported.
+"""
+import json
+from pathlib import Path
+
+import pytest
+
+from gubernator_tpu.testing.chaos import ChaosPlan
+from tools.gubproof import load_all_specs, run as gubproof_run
+from tools.gubproof.chaosplan import plan_from_trace
+from tools.gubproof.conformance import lint_spec
+from tools.gubproof.explore import explore_model
+from tools.gubproof.models import (
+    BreakerModel,
+    LeaseModel,
+    ReshardLeaseModel,
+    ReshardModel,
+    TierModel,
+    build_models,
+)
+from tools.gubproof.spec import SpecError, load_spec
+
+FIXTURES = Path(__file__).parent / "gubproof_fixtures"
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# -- specs ----------------------------------------------------------------
+def test_all_specs_load_and_validate():
+    specs = load_all_specs()
+    assert {s.id for s in specs} == {"breaker", "lease", "reshard", "tier"}
+    for s in specs:
+        assert s.bound.formula
+        assert s.machines
+        for m in s.machines:
+            assert m.initial in m.states
+            for t in m.transitions:
+                assert set(t.frm) <= set(m.states)
+                assert t.to in m.states
+
+
+def test_spec_loader_rejects_bad_edge(tmp_path):
+    spec = json.loads((FIXTURES / "spec_undeclared.json").read_text())
+    spec["machines"][0]["transitions"][0]["to"] = "nonexistent"
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(spec))
+    with pytest.raises(SpecError):
+        load_spec(p)
+
+
+# -- conformance: real modules are clean ----------------------------------
+def test_conformance_green_on_real_modules():
+    for spec in load_all_specs():
+        findings = lint_spec(spec, REPO)
+        assert _errors(findings) == [], (
+            f"spec {spec.id}: " + "; ".join(f.render() for f in findings)
+        )
+
+
+def test_real_modules_cross_link_their_specs():
+    for spec in load_all_specs():
+        src = (REPO / spec.module).read_text()
+        assert f"tools/gubproof/specs/{spec.path.name}" in src
+
+
+# -- conformance: seeded fixtures fail ------------------------------------
+def test_linter_catches_undeclared_transition():
+    spec = load_spec(FIXTURES / "spec_undeclared.json")
+    errs = _errors(lint_spec(spec, REPO))
+    assert len(errs) == 1, errs
+    assert "undeclared transition" in errs[0].message
+    assert errs[0].line == 24  # the sneaky_reset write
+
+
+def test_linter_catches_missing_guard():
+    spec = load_spec(FIXTURES / "spec_unguarded.json")
+    errs = _errors(lint_spec(spec, REPO))
+    # The unguarded write is flagged, and — since a guard-failing site
+    # does not implement its edge — the edge is also reported dead.
+    guard = [e for e in errs if "missing guard" in e.message]
+    assert len(guard) == 1, errs
+    assert "outcome" in guard[0].message
+    assert guard[0].line == 18  # the unconditional finish() write
+    assert all(
+        "missing guard" in e.message
+        or "no implementation site" in e.message
+        for e in errs
+    ), errs
+
+
+def test_linter_catches_dead_spec_edge():
+    spec = load_spec(FIXTURES / "spec_missing_edge.json")
+    errs = _errors(lint_spec(spec, REPO))
+    assert len(errs) == 1, errs
+    assert "no implementation site" in errs[0].message
+    assert "expire" in errs[0].message
+    # Anchored at the spec file, not the innocent module.
+    assert errs[0].path.endswith("spec_missing_edge.json")
+
+
+# -- explorer: exact closure of the documented algebra ---------------------
+def _explore(model):
+    res = explore_model(model)
+    assert res.closed, res.closure_note
+    assert res.violations == [], [v.message for v in res.violations]
+    return res
+
+
+def test_breaker_probe_bound_exact():
+    res = _explore(BreakerModel(load_all_specs()))
+    assert res.max_counters == {"half_open_probes_admitted": 1}
+
+
+def test_lease_bound_exact():
+    # L=4, H=2, fraction=1/4: admitted <= L(1 + H*f) == 6, reached.
+    res = _explore(LeaseModel(load_all_specs()))
+    assert res.max_counters == {"admitted": 6}
+
+
+def test_reshard_bounds_exact():
+    # Clean handoff: L(1 + f_handoff) == 5.  Rows lost to a crash:
+    # 2L + f*L == 9 (conservative fresh restart, never inflated).
+    res = _explore(ReshardModel(load_all_specs()))
+    assert res.max_counters == {"admitted_clean": 5, "admitted_lost": 9}
+
+
+def test_tier_cycle_bound_exact():
+    # L=4, 2 demote/promote cycles: L(1 + cycles) == 12.
+    res = _explore(TierModel(load_all_specs()))
+    assert res.max_counters == {"admitted": 12}
+
+
+def test_reshard_lease_composition_exact():
+    # The composed window: L(1 + H*f + f_handoff) == 7 clean, +L lost.
+    res = _explore(ReshardLeaseModel(load_all_specs()))
+    assert res.max_counters == {"admitted_clean": 7, "admitted_lost": 11}
+
+
+def test_every_spec_edge_fires_in_some_model():
+    specs = load_all_specs()
+    fired = set()
+    for model in build_models(specs):
+        fired |= explore_model(model).fired
+    declared = {
+        (s.id, m.name, t.id)
+        for s in specs for m in s.machines for t in m.transitions
+        if (s.id, m.name) != ("lease", "keys")  # linter-only machine
+    }
+    assert declared <= fired, declared - fired
+
+
+def test_explorer_rejects_loosened_bound():
+    # Documenting a LOOSER maximum than reality must fail the same as
+    # an exceeded one: exactness cuts both ways.
+    model = TierModel(load_all_specs())
+    model.expect_max = {"admitted": 13}
+    res = explore_model(model)
+    msgs = [v.message for v in res.violations]
+    assert any("not reproduced exactly" in m for m in msgs), msgs
+
+
+def test_depth_cap_is_an_error_not_a_pass():
+    res = explore_model(BreakerModel(load_all_specs()), depth=1)
+    assert not res.closed
+    assert "did not close" in res.closure_note
+
+
+# -- counterexample -> chaos plan ------------------------------------------
+def test_broken_reshard_variant_yields_counterexample():
+    res = explore_model(ReshardModel(load_all_specs(), replay_guard=False))
+    assert res.closed
+    assert res.violations, "replay-guard removal must violate conservation"
+    v = res.violations[0]
+    assert v.kind == "invariant"
+    assert "inflated" in v.message
+    assert "fault:dup_migrate" in v.trace
+
+
+def test_counterexample_round_trips_into_chaos_plan():
+    res = explore_model(ReshardModel(load_all_specs(), replay_guard=False))
+    v = res.violations[0]
+    plan = plan_from_trace(
+        "reshard-no-replay-guard", list(v.trace), v.message, seed=7
+    )
+    # The plan parses through the real loader (extra keys ignored) ...
+    cp = ChaosPlan.from_dict(plan)
+    assert cp.seed == 7
+    assert cp.rules, "a fault trace must lower to at least one rule"
+    # ... and carries the duplicate-delivery window: the Migrate
+    # handler ran, then the ack failed client-side -> sender retries.
+    dup = [r for r in cp.rules if r.method == "*Migrate*"]
+    assert any(r.phase == "after" and r.where == "client" for r in dup)
+    # Self-description survives for humans.
+    assert plan["model"] == "reshard-no-replay-guard"
+    assert plan["trace"] == list(v.trace)
+
+
+# -- CLI / runner ----------------------------------------------------------
+def test_run_all_phases_clean():
+    findings = gubproof_run(root=REPO)
+    assert _errors(findings) == [], [f.render() for f in findings]
+
+
+def test_run_rejects_unknown_phase():
+    with pytest.raises(ValueError):
+        gubproof_run(select=["nonsense"], root=REPO)
+
+
+def test_cli_select_depth_json(tmp_path, monkeypatch, capsys):
+    from tools.gubproof.__main__ import main
+
+    monkeypatch.chdir(REPO)
+    assert main(["--select", "lint,specs"]) == 0
+    capsys.readouterr()
+    # An insufficient depth cap is an error, not a silent pass.
+    assert main(["--depth", "2", "--select", "explore",
+                 "--dump-dir", str(tmp_path)]) == 1
+    capsys.readouterr()
+    assert main(["--json", "--select", "specs"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out) == []

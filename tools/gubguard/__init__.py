@@ -21,12 +21,14 @@ from tools.gubguard.core import Checker, Finding, run_checkers
 from tools.gubguard.envparity import EnvParityChecker
 from tools.gubguard.hostsync import HostSyncChecker
 from tools.gubguard.jitpurity import JitPurityChecker
+from tools.gubguard.lockcomplete import LockCompleteChecker
 from tools.gubguard.lockorder import LockOrderChecker
 
 ALL_CHECKERS = (
     "host-sync",
     "async-blocking",
     "lock-order",
+    "lock-complete",
     "jit-purity",
     "env-parity",
 )
@@ -37,6 +39,7 @@ def make_checkers(select: Optional[Sequence[str]] = None) -> List[Checker]:
         "host-sync": HostSyncChecker,
         "async-blocking": BlockingChecker,
         "lock-order": LockOrderChecker,
+        "lock-complete": LockCompleteChecker,
         "jit-purity": JitPurityChecker,
         "env-parity": EnvParityChecker,
     }

@@ -52,6 +52,12 @@ CLASS_LOCK_MAP = {
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
+    ("SketchBackend", "_compile_lock"): "sketch._compile_lock",
+    ("SketchBackend", "_spill_lock"): "sketch._spill_lock",
+    ("Clock", "_lock"): "clock._lock",
+    ("Daemon", "_set_peers_lock"): "daemon._set_peers_lock",
+    ("Service", "_peer_lock"): "service._peer_lock",
+    ("PeerClient", "_connect_lock"): "peer_client._connect_lock",
 }
 # receiver variable name -> canonical prefix
 VAR_ALIAS = {
@@ -92,10 +98,27 @@ VAR_ALIAS = {
 RANK = {
     "coalescer._fetch_slot": 1,
     "coalescer._dispatch_slot": 2,
+    # The event-loop asyncio.Locks rank with the coalescer slots,
+    # BEFORE every thread lock: each is acquired on the loop while
+    # holding no thread lock, and any thread lock taken inside runs on
+    # a pool thread or in a short critical section entered afterwards.
+    # set_peers flows Daemon -> Service, so the daemon's lock ranks
+    # first; the peer-client connect gate is a leaf among them.
+    "daemon._set_peers_lock": 3,
+    "service._peer_lock": 4,
+    "peer_client._connect_lock": 5,
     "backend._keymap_lock": 10,
     "backend._lock": 20,
     "engine._lock": 30,
+    # sketch._compile_lock serializes first-compile of a new batch
+    # shape against a throwaway state, deliberately OUTSIDE the
+    # dispatch lock (sketch._lock) — callers fetch the compiled step
+    # before taking _lock, so compile ranks before dispatch.
+    "sketch._compile_lock": 39,
     "sketch._lock": 40,
+    # sketch._spill_lock guards the dynamic-name spillover set; taken
+    # alone from the pressure-report path, never nested with dispatch.
+    "sketch._spill_lock": 41,
     "store._lock": 50,
     # coldtier._lock (runtime/coldtier.py cold-store rows + member
     # set) is a leaf taken alone: the request path's note_access probes
@@ -135,6 +158,11 @@ RANK = {
     # another lock while holding its own (exports run outside it).
     "tracing._lock": 70,
     "tracing.exporter._lock": 71,
+    # clock._lock (core/clock.py frozen-time guard) ranks dead last:
+    # now_ns() may be called under ANY other lock (timestamps are
+    # taken everywhere), the critical section is two loads, and the
+    # clock takes nothing while held.
+    "clock._lock": 80,
 }
 
 Site = Tuple[str, int]  # (relpath, line)

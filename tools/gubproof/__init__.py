@@ -1,0 +1,95 @@
+"""gubproof: machine-checked protocol specs for the admission planes.
+
+The third static-analysis plane, one layer above its siblings:
+
+  gubguard   AST invariants over the host source (lock order, host-sync
+             containment, env parity, ...)
+  gubtrace   jaxpr invariants over what XLA actually compiles
+  gubproof   PROTOCOL invariants over the distributed state machines —
+             the five interlocking planes that all claim a variant of
+             one bound, admitted <= limit x (1 + plane-factor)
+
+Three parts (docs/gubproof.md):
+
+  specs        declarative state-machine specs (states, guarded
+               transitions, the per-plane over-admission bound,
+               liveness obligations) in tools/gubproof/specs/*.json,
+               cross-linked from each protocol module;
+  conformance  an AST pass mapping every state-variable write site in
+               the real modules to a declared spec edge — an undeclared
+               transition, a missing guard, or a spec edge with no
+               implementation site is an error;
+  explore      an exhaustive explicit-state BFS over small-scope
+               abstract oracles of each plane (and the reshard+lease
+               composition), checking the admission bound EXACTLY
+               (reachable and never exceeded), conservation, and
+               liveness; a counterexample is emitted as a seeded
+               GUBER_CHAOS_PLAN so testing/chaos.py replays it against
+               the real daemon.
+
+Run as:
+
+    python -m tools.gubproof                  # specs + lint + explore
+    python -m tools.gubproof --select lint
+    python -m tools.gubproof --depth 64 --json
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.gubguard.core import Finding
+
+ALL_PHASES = ("specs", "lint", "explore")
+
+SPEC_DIR = Path(__file__).parent / "specs"
+
+
+def load_all_specs(spec_dir: Optional[Path] = None) -> list:
+    """Every protocol spec in the spec directory, validated."""
+    from tools.gubproof.spec import load_spec
+
+    d = spec_dir or SPEC_DIR
+    return [load_spec(p) for p in sorted(d.glob("*.json"))]
+
+
+def run(
+    select: Optional[Sequence[str]] = None,
+    root: Optional[Path] = None,
+    depth: Optional[int] = None,
+    dump_dir: Optional[Path] = None,
+) -> List[Finding]:
+    """Run the selected phases; returns sorted findings (exploration
+    results ride back as findings too — a bound that is not tight, a
+    violated invariant, or an unmet liveness obligation is an error)."""
+    from tools.gubproof.conformance import lint_spec
+    from tools.gubproof.explore import explore_all_findings
+    from tools.gubproof.spec import SpecError, load_spec
+
+    phases = list(select) if select else list(ALL_PHASES)
+    unknown = [p for p in phases if p not in ALL_PHASES]
+    if unknown:
+        raise ValueError(f"unknown gubproof phases: {unknown}")
+    root = root or Path.cwd()
+    findings: List[Finding] = []
+
+    specs = []
+    for p in sorted(SPEC_DIR.glob("*.json")):
+        try:
+            specs.append(load_spec(p))
+        except SpecError as e:
+            findings.append(Finding(
+                checker="specs",
+                path=p.relative_to(root).as_posix()
+                if p.is_relative_to(root) else p.as_posix(),
+                line=1, message=str(e),
+            ))
+    if "lint" in phases:
+        for spec in specs:
+            findings.extend(lint_spec(spec, root))
+    if "explore" in phases:
+        findings.extend(
+            explore_all_findings(specs, depth=depth, dump_dir=dump_dir)
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings

@@ -1,0 +1,98 @@
+"""CLI: python -m tools.gubproof [--select specs,lint,explore] [--strict]."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from tools.gubproof import ALL_PHASES, run
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gubproof",
+        description=(
+            "Protocol specs, conformance linting, and small-scope model "
+            "checking of the over-admission algebra (see docs/gubproof.md)."
+        ),
+    )
+    ap.add_argument(
+        "--select", metavar="PHASES",
+        help="comma-separated phase subset of: " + ", ".join(ALL_PHASES),
+    )
+    ap.add_argument(
+        "--depth", type=int, default=None, metavar="N",
+        help=(
+            "BFS depth cap for the explorer; the pinned scopes close "
+            "unaided, so an insufficient cap is itself an error "
+            "(default: unbounded)"
+        ),
+    )
+    ap.add_argument(
+        "--dump-dir", default=None, metavar="DIR",
+        help=(
+            "write counterexample chaos plans (GUBER_CHAOS_PLAN JSON) "
+            "here; honors GUBPROOF_DUMP_DIR (default: gubproof-dumps, "
+            "only written on violation)"
+        ),
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="treat warnings as errors",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="repo root the linted modules resolve against (default: cwd)",
+    )
+    args = ap.parse_args(argv)
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    if args.dump_dir is not None:
+        dump_dir = Path(args.dump_dir)
+    else:
+        from gubernator_tpu.core.config import gubproof_dump_dir_from_env
+
+        dump_dir = Path(gubproof_dump_dir_from_env())
+    depth = args.depth
+    if depth is None:
+        from gubernator_tpu.core.config import gubproof_depth_from_env
+
+        depth = gubproof_depth_from_env()
+    try:
+        findings = run(
+            select=select, root=Path(args.root),
+            depth=depth, dump_dir=dump_dir,
+        )
+    except ValueError as e:
+        print(f"gubproof: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    errors = [
+        f for f in findings
+        if f.severity == "error" or (args.strict and f.severity == "warning")
+    ]
+    warnings = [f for f in findings if f.severity == "warning"]
+    if not args.as_json:
+        print(
+            f"gubproof: {len(errors)} error(s), "
+            f"{len(warnings)} warning(s)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Lower an explorer counterexample to a seeded GUBER_CHAOS_PLAN.
+
+A model-checker trace is a sequence of abstract action labels.  The
+fault actions among them map onto concrete chaos rules — the same Rule
+schema testing/chaos.py loads from GUBER_CHAOS_PLAN — so a violated
+bound is not just a report: it ships as a plan the integration harness
+replays against the real daemon (`probability=1.0`, bounded
+`max_count`, fixed `seed` — deterministic by construction).
+
+Non-fault labels (serve:*, grant:*, tick:*) need no rule: they are the
+workload the harness drives anyway.  The model name, the violated
+invariant, and the full trace ride along as extra keys —
+ChaosPlan.from_dict ignores unknown keys, so the plan stays
+self-describing without breaking the loader.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# label (or its prefix before ':') -> chaos Rule dict.  Methods are
+# fnmatch globs over the fully-qualified gRPC method name.
+_FAULT_RULES: Dict[str, Dict[str, object]] = {
+    "fault:prepare_fail": {
+        "op": "error", "where": "server", "phase": "before",
+        "method": "*Handoff*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 3,
+        "message": "gubproof: Handoff(PREPARE) refused",
+    },
+    "fault:transfer_fail": {
+        "op": "error", "where": "server", "phase": "before",
+        "method": "*Handoff*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 3,
+        "message": "gubproof: Handoff(TRANSFER) refused",
+    },
+    "fault:cutover_fail": {
+        "op": "error", "where": "server", "phase": "before",
+        "method": "*Handoff*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 3,
+        "message": "gubproof: Handoff(CUTOVER) refused",
+    },
+    "fault:chunk_lost": {
+        "op": "error", "where": "server", "phase": "before",
+        "method": "*Migrate*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 1,
+        "message": "gubproof: migrate chunk dropped on the wire",
+    },
+    # The replay-guard counterexample: the handler RAN (rows injected)
+    # and then the RPC failed — the sender retries and the chunk is
+    # delivered twice.  phase="after" is exactly that window.
+    "fault:dup_migrate": {
+        "op": "error", "where": "client", "phase": "after",
+        "method": "*Migrate*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 1,
+        "message": "gubproof: migrate ack dropped after delivery",
+    },
+    "watchdog:self_cutover": {
+        "op": "drop", "where": "client", "phase": "before",
+        "method": "*Handoff*", "probability": 1.0, "max_count": 2,
+        "message": "gubproof: sender silenced until watchdog fires",
+    },
+    # breaker probe failures: the peer path the breaker wraps.
+    "fail": {
+        "op": "error", "where": "client", "phase": "before",
+        "method": "*GetPeerRateLimits*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 4,
+        "message": "gubproof: peer batch refused (breaker trip/probe)",
+    },
+    "sweep:expire": {
+        "op": "delay", "where": "client", "phase": "before",
+        "method": "*Reconcile*", "probability": 1.0,
+        "delay_s": 0.2, "max_count": 4,
+        "message": "gubproof: holder partitioned past its lease TTL",
+    },
+}
+
+
+def _rule_for(label: str) -> Optional[Dict[str, object]]:
+    if label in _FAULT_RULES:
+        return dict(_FAULT_RULES[label])
+    head = label.split(":", 1)[0]
+    if head in _FAULT_RULES:
+        return dict(_FAULT_RULES[head])
+    # entity-suffixed labels: "sweep:expire:c1" -> "sweep:expire"
+    parts = label.rsplit(":", 1)
+    if len(parts) == 2 and parts[0] in _FAULT_RULES:
+        return dict(_FAULT_RULES[parts[0]])
+    return None
+
+
+def plan_from_trace(
+    model_name: str,
+    labels: List[str],
+    violation: str,
+    seed: int = 0,
+) -> Dict[str, object]:
+    """Build a ChaosPlan-compatible dict from a counterexample trace.
+    Deduplicates rules (same fault fired twice needs one rule — the
+    max_count already covers repetition) and preserves trace order."""
+    rules: List[Dict[str, object]] = []
+    seen = set()
+    for label in labels:
+        rule = _rule_for(label)
+        if rule is None:
+            continue
+        key = (rule["op"], rule["where"], rule["phase"], rule["method"])
+        if key in seen:
+            continue
+        seen.add(key)
+        rules.append(rule)
+    return {
+        "seed": seed,
+        "rules": rules,
+        # Extra keys: ChaosPlan.from_dict ignores them, humans don't.
+        "model": model_name,
+        "violation": violation,
+        "trace": list(labels),
+    }

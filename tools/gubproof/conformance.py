@@ -1,0 +1,532 @@
+"""Conformance linting: every state write in the code is a spec edge.
+
+The gubguard/gubtrace discipline applied one layer up: the AST pass
+maps every state-variable write site in a protocol module to a declared
+transition in its spec, and fails on
+
+  * an UNDECLARED TRANSITION — a write (or container mutation, or
+    watched residency call) no spec edge covers;
+  * a MISSING GUARD — the write is declared, but none of the matching
+    edges finds its guard terms in the site's guard context;
+  * a SPEC EDGE WITH NO IMPLEMENTATION SITE — the spec promises a
+    transition the code cannot perform.
+
+Matching is deliberately syntactic and local (this is a linter, not a
+verifier):
+
+  * a site in function F matches edge E when E.fn == F, or E.fn is a
+    function that directly calls F (one level of helper indirection —
+    `record_failure` -> `_open` -> `_set_state`);
+  * the guard context of a match is every identifier term (Name ids
+    and attribute names) appearing in an `if`/`while`/ternary/`assert`
+    test or a comprehension filter of F or of E.fn;
+  * `from`-state correctness is NOT checked here — it is checked
+    dynamically by the explorer (tools/gubproof/explore.py), which
+    fires every edge of the abstract model and validates each against
+    the spec's (from, to) pairs.
+
+Construction is not a transition: a write in `__init__` (or a
+dataclass class-body default) that resolves to the machine's declared
+initial state needs no edge; resolving to anything else is an error.
+
+Suppression rides the gubguard pragma: `# gubproof: ok` on the flagged
+line or the line above (same grammar as `# gubguard: ok`).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.gubguard.core import Finding, load_module
+from tools.gubproof.spec import Machine, ProtocolSpec, Transition
+
+CHECKER = "conformance"
+
+_PRAGMA_RE = re.compile(r"#\s*gubproof:\s*ok(?:=(?P<names>[\w,\-]+))?")
+
+# Container methods that mutate a dict-machine's membership.  Anything
+# here that is not a declarable op (setitem/delitem/pop/setdefault) can
+# never match an edge, so `.clear()`/`.update()` on a state container
+# is always an undeclared transition — the right strictness.
+_DICT_MUTATORS = ("pop", "setdefault", "update", "clear", "popitem")
+
+
+@dataclass
+class _Site:
+    """One state-write site resolved from the AST."""
+
+    fn: str  # enclosing function name ("" = module/class body)
+    cls: str  # enclosing class name ("" = module level)
+    line: int
+    kind: str  # "attr" | "dict" | "call"
+    to_state: str = ""  # attr kind: resolved target state
+    op: str = ""  # dict kind
+    call: str = ""  # calls kind
+    desc: str = ""  # human-readable site description
+
+
+class _Index(ast.NodeVisitor):
+    """Function/class index + per-function guard context + call graph."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, ast.AST] = {}
+        self.fn_of_node: Dict[int, str] = {}
+        self.cls_of_node: Dict[int, str] = {}
+        self._fn_stack: List[str] = []
+        self._cls_stack: List[str] = []
+        # fn name -> method/function names it calls directly
+        self.calls: Dict[str, Set[str]] = {}
+        # fn name -> identifier terms in its branch tests
+        self.guard_ctx: Dict[str, Set[str]] = {}
+
+    def _enter(self, node: ast.AST) -> None:
+        fn = self._fn_stack[-1] if self._fn_stack else ""
+        cls = self._cls_stack[-1] if self._cls_stack else ""
+        self.fn_of_node[id(node)] = fn
+        self.cls_of_node[id(node)] = cls
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._enter(node)
+        self._cls_stack.append(node.name)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self._enter(node)
+        # Nested defs keep the outer name: sites in a closure belong to
+        # the enclosing API function for matching purposes.
+        name = self._fn_stack[-1] if self._fn_stack else node.name
+        if not self._fn_stack:
+            self.funcs[node.name] = node
+            self.calls.setdefault(node.name, set())
+            self.guard_ctx.setdefault(node.name, set())
+        self._fn_stack.append(name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _note_test(self, test: ast.AST) -> None:
+        if self._fn_stack:
+            self.guard_ctx[self._fn_stack[-1]].update(_terms(test))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._enter(node)
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._enter(node)
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._enter(node)
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._enter(node)
+        self._note_test(node.test)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        for cond in node.ifs:
+            self._note_test(cond)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._enter(node)
+        if self._fn_stack:
+            callee = None
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee:
+                self.calls[self._fn_stack[-1]].add(callee)
+        self.generic_visit(node)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        if id(node) not in self.fn_of_node:
+            self._enter(node)
+        super().generic_visit(node)
+
+
+def _terms(node: ast.AST) -> Set[str]:
+    """Every identifier term in an expression: Name ids and attribute
+    names (so `self.cfg.max_holders` contributes both `cfg` and
+    `max_holders`)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _gubproof_pragmas(source: str) -> Dict[int, Set[str]]:
+    pragmas: Dict[int, Set[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            names = m.group("names")
+            pragmas[tok.start[0]] = (
+                set(n.strip() for n in names.split(",") if n.strip())
+                if names else {"*"}
+            )
+    except tokenize.TokenError:
+        pass
+    return pragmas
+
+
+def _suppressed(pragmas: Dict[int, Set[str]], line: int) -> bool:
+    for ln in (line, line - 1):
+        names = pragmas.get(ln)
+        if names and ("*" in names or CHECKER in names):
+            return True
+    return False
+
+
+def _resolve_states(
+    node: ast.AST, consts: Dict[str, str]
+) -> Optional[List[str]]:
+    """Resolve a written value to spec state name(s): a bare Name, a
+    dotted name (full chain or last segment), or a ternary (both
+    branches).  None = unresolvable."""
+    if isinstance(node, ast.IfExp):
+        a = _resolve_states(node.body, consts)
+        b = _resolve_states(node.orelse, consts)
+        if a is None or b is None:
+            return None
+        return a + b
+    if isinstance(node, ast.Name):
+        st = consts.get(node.id)
+        return [st] if st is not None else None
+    if isinstance(node, ast.Attribute):
+        parts: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            dotted = ".".join(reversed(parts))
+            st = consts.get(dotted, consts.get(parts[0]))
+            return [st] if st is not None else None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # A raw string literal: valid only if it IS a state name.
+        return [node.value] if node.value in consts.values() else None
+    return None
+
+
+def _recv_attr(node: ast.AST, receivers: Tuple[str, ...], attr: str) -> bool:
+    """True when `node` is `<recv>.<attr>` for a bound receiver."""
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id in receivers
+    )
+
+
+def _fn_matches(t: Transition, site_fn: str, idx: _Index) -> bool:
+    if t.fn == site_fn:
+        return True
+    return site_fn in idx.calls.get(t.fn, ())
+
+
+def _guard_ok(t: Transition, site_fn: str, idx: _Index) -> bool:
+    ctx = set(idx.guard_ctx.get(site_fn, ()))
+    if t.fn != site_fn:
+        ctx |= idx.guard_ctx.get(t.fn, set())
+    return all(g in ctx for g in t.guards)
+
+
+def _collect_attr_sites(
+    tree: ast.Module, m: Machine, idx: _Index
+) -> Tuple[List[_Site], List[Finding], str]:
+    """Attr-machine sites: direct state-attr writes, setter calls, and
+    construction sites (returned separately as findings when they set a
+    non-initial state).  Third element is the relpath placeholder filled
+    by the caller."""
+    sites: List[_Site] = []
+    bad: List[Finding] = []
+    receivers = m.receivers or ("self",)
+    for node in ast.walk(tree):
+        fn = idx.fn_of_node.get(id(node), "")
+        cls = idx.cls_of_node.get(id(node), "")
+        # Class-body default (dataclass field): the initial-state rule.
+        if (
+            isinstance(node, (ast.AnnAssign, ast.Assign))
+            and not fn
+            and cls == m.owner_class
+        ):
+            targets = (
+                [node.target] if isinstance(node, ast.AnnAssign)
+                else node.targets
+            )
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id == m.state_attr:
+                    val = getattr(node, "value", None)
+                    if val is None:
+                        continue
+                    states = _resolve_states(val, m.state_consts)
+                    if states != [m.initial]:
+                        bad.append(_finding(
+                            node.lineno,
+                            f"{m.owner_class}.{m.state_attr} default "
+                            f"must be the declared initial state "
+                            f"{m.initial!r} (machine {m.name})",
+                        ))
+            continue
+        if not fn:
+            continue
+        written: Optional[ast.AST] = None
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                [node.target] if isinstance(node, ast.AnnAssign)
+                else node.targets
+            )
+            if any(_recv_attr(t, receivers, m.state_attr) for t in targets):
+                written = getattr(node, "value", None)
+        elif isinstance(node, ast.AugAssign):
+            if _recv_attr(node.target, receivers, m.state_attr):
+                bad.append(_finding(
+                    line,
+                    f"augmented write to {m.state_attr} is never a "
+                    f"declarable transition (machine {m.name})",
+                ))
+                continue
+        elif isinstance(node, ast.Call) and m.setter:
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == m.setter
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in receivers
+                and node.args
+            ):
+                written = node.args[0]
+        if written is None:
+            continue
+        if fn == m.setter:
+            continue  # the setter's own mechanics, not a transition
+        states = _resolve_states(written, m.state_consts)
+        if states is None:
+            bad.append(_finding(
+                line,
+                f"{fn} writes {m.state_attr} with a value that does "
+                f"not resolve to a declared state of machine {m.name} "
+                "(only named state constants are allowed)",
+            ))
+            continue
+        for st in states:
+            if fn == "__init__" and st == m.initial:
+                continue  # construction, not a transition
+            sites.append(_Site(
+                fn=fn, cls=cls, line=line, kind="attr", to_state=st,
+                desc=f"{fn} sets {m.state_attr} -> {st!r}",
+            ))
+    return sites, bad, ""
+
+
+def _collect_dict_sites(
+    tree: ast.Module, m: Machine, idx: _Index
+) -> List[_Site]:
+    sites: List[_Site] = []
+    receivers = m.receivers or ("self",)
+    for node in ast.walk(tree):
+        fn = idx.fn_of_node.get(id(node), "")
+        cls = idx.cls_of_node.get(id(node), "")
+        if not fn:
+            continue
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and _recv_attr(
+                    tgt.value, receivers, m.state_attr
+                ):
+                    sites.append(_Site(
+                        fn=fn, cls=cls, line=line, kind="dict",
+                        op="setitem",
+                        desc=f"{fn}: {m.state_attr}[...] = ...",
+                    ))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and _recv_attr(
+                    tgt.value, receivers, m.state_attr
+                ):
+                    sites.append(_Site(
+                        fn=fn, cls=cls, line=line, kind="dict",
+                        op="delitem",
+                        desc=f"{fn}: del {m.state_attr}[...]",
+                    ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _DICT_MUTATORS
+                and _recv_attr(f.value, receivers, m.state_attr)
+            ):
+                sites.append(_Site(
+                    fn=fn, cls=cls, line=line, kind="dict", op=f.attr,
+                    desc=f"{fn}: {m.state_attr}.{f.attr}(...)",
+                ))
+    return sites
+
+
+def _collect_call_sites(
+    tree: ast.Module, m: Machine, idx: _Index
+) -> List[_Site]:
+    sites: List[_Site] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = idx.fn_of_node.get(id(node), "")
+        if not fn:
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in m.watched_calls:
+            sites.append(_Site(
+                fn=fn, cls=idx.cls_of_node.get(id(node), ""),
+                line=node.lineno, kind="call", call=f.attr,
+                desc=f"{fn} calls .{f.attr}(...)",
+            ))
+    return sites
+
+
+def _finding(line: int, message: str, path: str = "",
+             severity: str = "error") -> Finding:
+    return Finding(
+        checker=CHECKER, path=path, line=line, message=message,
+        severity=severity,
+    )
+
+
+def _repath(f: Finding, path: str) -> Finding:
+    return Finding(
+        checker=f.checker, path=path, line=f.line, message=f.message,
+        severity=f.severity,
+    )
+
+
+def lint_machine(
+    spec: ProtocolSpec, m: Machine, tree: ast.Module, relpath: str,
+    pragmas: Dict[int, Set[str]],
+) -> List[Finding]:
+    idx = _Index()
+    idx.visit(tree)
+    out: List[Finding] = []
+    if m.kind == "attr":
+        sites, bad, _ = _collect_attr_sites(tree, m, idx)
+        out.extend(_repath(f, relpath) for f in bad)
+    elif m.kind == "dict":
+        sites = _collect_dict_sites(tree, m, idx)
+    else:
+        sites = _collect_call_sites(tree, m, idx)
+
+    implemented: Set[str] = set()
+    for site in sites:
+        if m.kind == "attr":
+            cands = [
+                t for t in m.transitions
+                if t.to == site.to_state and _fn_matches(t, site.fn, idx)
+            ]
+        elif m.kind == "dict":
+            cands = [
+                t for t in m.transitions
+                if t.op == site.op and _fn_matches(t, site.fn, idx)
+            ]
+        else:
+            cands = [
+                t for t in m.transitions
+                if t.call == site.call and _fn_matches(t, site.fn, idx)
+            ]
+        if not cands:
+            out.append(_finding(
+                site.line,
+                f"undeclared transition: {site.desc} matches no edge "
+                f"of spec {spec.id!r} machine {m.name!r}",
+                path=relpath,
+            ))
+            continue
+        passing = [t for t in cands if _guard_ok(t, site.fn, idx)]
+        if not passing:
+            missing = sorted({
+                g for t in cands for g in t.guards
+                if not _guard_ok(t, site.fn, idx) and g not in
+                idx.guard_ctx.get(site.fn, set())
+                | idx.guard_ctx.get(t.fn, set())
+            })
+            out.append(_finding(
+                site.line,
+                f"missing guard: {site.desc} matches edge(s) "
+                f"{', '.join(t.id for t in cands)} of spec "
+                f"{spec.id!r} machine {m.name!r}, but guard term(s) "
+                f"{missing} appear in no branch test of the site",
+                path=relpath,
+            ))
+            continue
+        implemented.update(t.id for t in passing)
+
+    for t in m.transitions:
+        if t.id not in implemented:
+            out.append(_finding(
+                1,
+                f"spec edge {t.id!r} "
+                f"({'|'.join(t.frm)} -> {t.to}, fn {t.fn}) of machine "
+                f"{m.name!r} has no implementation site in {relpath}",
+                path=spec_relpath(spec),
+            ))
+    return [f for f in out if not _suppressed(pragmas, f.line)
+            or f.path != relpath]
+
+
+def spec_relpath(spec: ProtocolSpec) -> str:
+    p = spec.path.as_posix()
+    i = p.rfind("tools/gubproof/")
+    return p[i:] if i >= 0 else p
+
+
+def lint_spec(spec: ProtocolSpec, root: Path) -> List[Finding]:
+    """Lint one protocol spec against its implementation module."""
+    mod_path = root / spec.module
+    if not mod_path.is_file():
+        return [_finding(
+            1,
+            f"implementation module {spec.module} not found",
+            path=spec_relpath(spec),
+        )]
+    mod = load_module(mod_path, root)
+    if mod is None:
+        return [_finding(
+            1,
+            f"implementation module {spec.module} failed to parse",
+            path=spec_relpath(spec),
+        )]
+    pragmas = _gubproof_pragmas(mod.source)
+    out: List[Finding] = []
+    # Cross-link: the module must point readers at its spec.
+    link = f"tools/gubproof/specs/{spec.path.name}"
+    if link not in mod.source:
+        out.append(_finding(
+            1,
+            f"module does not cross-link its protocol spec "
+            f"({link})",
+            path=mod.relpath, severity="warning",
+        ))
+    for m in spec.machines:
+        out.extend(lint_machine(spec, m, mod.tree, mod.relpath, pragmas))
+    return out

@@ -1,0 +1,316 @@
+"""Exhaustive explicit-state exploration of the admission-plane models.
+
+Plain breadth-first enumeration with state interning — the scopes in
+tools/gubproof/models.py are pinned small enough (tens to a few
+thousand states per plane, low-hundreds-of-thousands for the
+composition) that the FULL reachable set closes in well under a
+second, so there is no frontier sampling, no partial-order reduction,
+and no hashing tricks to mistrust.
+
+What closure buys, per model:
+
+  safety       every reachable state satisfies the model invariant
+               (the plane's documented over-admission bound plus a
+               conservation check that catches inflation bugs the
+               bound alone would miss);
+  exactness    each documented maximum is REACHED, not just respected
+               — `expect_max` must equal the explored maximum exactly,
+               so a silently-loosened bound in the docs fails the same
+               as an exceeded one;
+  spec x-val   every fired edge must exist in the spec with matching
+               (from, to) projections, and for `covered` machines
+               every spec edge must fire somewhere and no projection
+               may change without an edge (the dynamic complement of
+               the conformance linter, which is from-state-blind);
+  liveness     every state where an obligation applies can still reach
+               a goal state (backward reachability over the closed
+               graph — sound and complete at this scope).
+
+A violated invariant yields a counterexample trace (the action-label
+path from the initial state), which chaosplan.py lowers to a seeded
+GUBER_CHAOS_PLAN for replay against the real daemon.
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.gubguard.core import Finding
+from tools.gubproof.models import Model, build_models
+from tools.gubproof.spec import ProtocolSpec, Transition
+
+CHECKER = "explore"
+
+
+@dataclass
+class Violation:
+    kind: str  # "invariant" | "edge" | "silent" | "liveness"
+    message: str
+    trace: Tuple[str, ...]
+    state: tuple
+
+
+@dataclass
+class ExploreResult:
+    model: str
+    states: int = 0
+    closed: bool = True
+    closure_note: str = ""
+    max_counters: Dict[str, int] = field(default_factory=dict)
+    fired: Set[Tuple[str, str, str]] = field(default_factory=set)
+    violations: List[Violation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.closed and not self.violations
+
+
+def _lookup(model: Model, sid: str, machine: str, eid: str) -> Optional[Transition]:
+    spec = model.specs.get(sid)
+    if spec is None:
+        return None
+    try:
+        m = spec.machine(machine)
+    except KeyError:
+        return None
+    for t in m.transitions:
+        if t.id == eid:
+            return t
+    return None
+
+
+def explore_model(model: Model, depth: Optional[int] = None) -> ExploreResult:
+    """Close the model's reachable state set and check everything."""
+    res = ExploreResult(model=model.name)
+    init = model.initial()
+    index: Dict[tuple, int] = {init: 0}
+    states: List[tuple] = [init]
+    parents: List[Optional[Tuple[int, str]]] = [None]
+    succ_idx: List[List[int]] = []  # forward adjacency, filled per expansion
+    bad: Set[int] = set()  # violating states: reported, never expanded
+
+    def trace_to(i: int) -> Tuple[str, ...]:
+        labels: List[str] = []
+        while parents[i] is not None:
+            p, label = parents[i]  # type: ignore[misc]
+            labels.append(label)
+            i = p
+        return tuple(reversed(labels))
+
+    def note_counters(s: tuple) -> None:
+        for k, v in model.counters(s).items():
+            if v > res.max_counters.get(k, 0):
+                res.max_counters[k] = v
+
+    msg = model.invariant(init)
+    if msg is not None:
+        bad.add(0)
+        res.violations.append(Violation("invariant", msg, (), init))
+    else:
+        note_counters(init)
+
+    frontier = deque([0]) if 0 not in bad else deque()
+    level = 0
+    while frontier:
+        if depth is not None and level >= depth:
+            res.closed = False
+            res.closure_note = (
+                f"depth cap {depth} reached with {len(frontier)} "
+                f"states unexpanded — exploration did not close"
+            )
+            break
+        level += 1
+        for _ in range(len(frontier)):
+            i = frontier.popleft()
+            s = states[i]
+            while len(succ_idx) <= i:
+                succ_idx.append([])
+            pb = model.proj(s)
+            for label, edges, ns in model.successors(s):
+                j = index.get(ns)
+                fresh = j is None
+                if fresh:
+                    j = len(states)
+                    index[ns] = j
+                    states.append(ns)
+                    parents.append((i, label))
+                succ_idx[i].append(j)  # type: ignore[arg-type]
+
+                # -- spec cross-validation (every firing, fresh or not)
+                pa = model.proj(ns)
+                moved: Set[Tuple[str, str, Optional[str]]] = set()
+                for sid, mname, eid, ent in edges:
+                    res.fired.add((sid, mname, eid))
+                    t = _lookup(model, sid, mname, eid)
+                    if t is None:
+                        res.violations.append(Violation(
+                            "edge",
+                            f"action '{label}' fired unknown spec edge "
+                            f"{sid}.{mname}.{eid}",
+                            trace_to(j), ns,
+                        ))
+                        continue
+                    moved.add((sid, mname, ent))
+                    before = pb.get((sid, mname, ent))
+                    after = pa.get((sid, mname, ent))
+                    if before is not None and before not in t.frm:
+                        res.violations.append(Violation(
+                            "edge",
+                            f"action '{label}' fired {sid}.{mname}.{eid} "
+                            f"from state '{before}' but the spec declares "
+                            f"from {list(t.frm)}",
+                            trace_to(j), ns,
+                        ))
+                    if after is not None and after != t.to:
+                        res.violations.append(Violation(
+                            "edge",
+                            f"action '{label}' fired {sid}.{mname}.{eid} "
+                            f"landing in '{after}' but the spec declares "
+                            f"to '{t.to}'",
+                            trace_to(j), ns,
+                        ))
+                for key in set(pb) | set(pa):
+                    sid, mname, _ent = key
+                    if (sid, mname) not in model.covered or key in moved:
+                        continue
+                    b, a = pb.get(key), pa.get(key)
+                    if b is not None and a is not None and b != a:
+                        res.violations.append(Violation(
+                            "silent",
+                            f"action '{label}' moved {sid}.{mname} "
+                            f"'{b}' -> '{a}' without firing a spec edge",
+                            trace_to(j), ns,
+                        ))
+
+                if fresh:
+                    msg = model.invariant(ns)
+                    if msg is not None:
+                        bad.add(j)  # terminal: report once, don't expand
+                        res.violations.append(
+                            Violation("invariant", msg, trace_to(j), ns)
+                        )
+                    else:
+                        note_counters(ns)
+                        frontier.append(j)  # type: ignore[arg-type]
+            if len(states) > model.state_cap:
+                res.closed = False
+                res.closure_note = (
+                    f"state cap {model.state_cap} exceeded — the scope "
+                    "is no longer small; shrink the model"
+                )
+                frontier.clear()
+                break
+
+    res.states = len(states)
+    if not res.closed:
+        return res
+
+    # -- exactness: documented maxima reproduced, not just respected ----
+    for name, want in model.expect_max.items():
+        got = res.max_counters.get(name, 0)
+        if got != want:
+            res.violations.append(Violation(
+                "invariant",
+                f"documented bound not reproduced exactly: max "
+                f"{name} == {got} explored, spec documents {want}"
+                + (" (bound looser than reality)" if got < want else
+                   " (bound EXCEEDED)"),
+                (), states[0],
+            ))
+
+    # -- edge coverage for covered machines ------------------------------
+    for sid, mname in model.covered:
+        t_ids = {
+            t.id for t in model.specs[sid].machine(mname).transitions
+        }
+        missed = sorted(
+            t_ids - {e for s2, m2, e in res.fired if (s2, m2) == (sid, mname)}
+        )
+        for eid in missed:
+            res.violations.append(Violation(
+                "edge",
+                f"spec edge {sid}.{mname}.{eid} never fired in the "
+                f"closed exploration ({res.states} states) — dead spec "
+                "edge or model gap",
+                (), states[0],
+            ))
+
+    # -- liveness: applies-states must reach a goal ----------------------
+    rev: List[List[int]] = [[] for _ in states]
+    for i, outs in enumerate(succ_idx):
+        for j in outs:
+            rev[j].append(i)
+    live_idx = [i for i in range(len(states)) if i not in bad]
+    for oid, applies, goal in model.liveness():
+        reach = {i for i in live_idx if goal(states[i])}
+        q = deque(reach)
+        while q:
+            j = q.popleft()
+            for i in rev[j]:
+                if i not in reach and i not in bad:
+                    reach.add(i)
+                    q.append(i)
+        stuck = [i for i in live_idx if applies(states[i]) and i not in reach]
+        if stuck:
+            w = min(stuck)  # earliest-interned == a shortest witness
+            res.violations.append(Violation(
+                "liveness",
+                f"obligation '{oid}' unmet: {len(stuck)} reachable "
+                f"state(s) where it applies can never reach a goal "
+                f"state; witness at depth {len(trace_to(w))}",
+                trace_to(w), states[w],
+            ))
+    return res
+
+
+def _anchor(model: Model, root: Path) -> str:
+    from tools.gubproof.conformance import spec_relpath
+
+    spec = model.specs.get(model.name)
+    if spec is not None:
+        return spec_relpath(spec)
+    return "tools/gubproof/models.py"
+
+
+def explore_all_findings(
+    specs: Sequence[ProtocolSpec],
+    depth: Optional[int] = None,
+    dump_dir: Optional[Path] = None,
+) -> List[Finding]:
+    """Explore every model buildable from the loaded specs; violations
+    come back as findings, and each counterexample trace is dumped as a
+    seeded chaos plan under `dump_dir` for testing/chaos.py replay."""
+    from tools.gubproof.chaosplan import plan_from_trace
+
+    findings: List[Finding] = []
+    root = Path.cwd()
+    for model in build_models(specs):
+        res = explore_model(model, depth=depth)
+        path = _anchor(model, root)
+        if not res.closed:
+            findings.append(Finding(
+                checker=CHECKER, path=path, line=1,
+                message=f"[{model.name}] {res.closure_note}",
+            ))
+        for k, v in enumerate(res.violations):
+            note = ""
+            if dump_dir is not None and v.trace:
+                dump_dir.mkdir(parents=True, exist_ok=True)
+                plan = plan_from_trace(
+                    model.name, list(v.trace), v.message, seed=k
+                )
+                out = dump_dir / f"{model.name}-{k}.chaosplan.json"
+                out.write_text(json.dumps(plan, indent=2) + "\n")
+                note = f" (chaos plan: {out})"
+            findings.append(Finding(
+                checker=CHECKER, path=path, line=1,
+                message=(
+                    f"[{model.name}] {v.kind}: {v.message}"
+                    + (f"; trace: {' -> '.join(v.trace)}" if v.trace else "")
+                    + note
+                ),
+            ))
+    return findings

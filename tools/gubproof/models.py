@@ -1,0 +1,699 @@
+"""Small-scope abstract models of the admission planes.
+
+Each model is a finite oracle of one protocol plane (or a composition)
+at the pinned small scope — 2 peers, 1 key, 1-2 holders, limits small
+enough that the explorer closes the full reachable set — mirroring the
+pymodel discipline (core/pymodel.py): pure-python semantics the real
+implementation is checked against, here by exhaustive BFS instead of
+sampled replay.
+
+Every successor is tagged with the spec edge(s) it fires, so the
+explorer cross-validates model against spec BOTH ways: a fired edge
+must exist with matching (from, to) states, and every edge of a
+model's covered machines must fire somewhere in the closed state
+graph (the dynamic complement of the conformance linter's static
+`from`-blindness).
+
+The documented over-admission algebra, reproduced EXACTLY (the
+explorer fails if a maximum is exceeded OR never reached):
+
+  breaker        probes admitted per open episode  == half_open_probes (1)
+  lease          admitted <= L(1 + H*f)            == 6   (L=4, H=2, f=1/4)
+  reshard        admitted <= L(1 + f_h)            == 5   (rows delivered)
+                 admitted <= 2L + f_h*L            == 9   (rows lost -> fresh)
+  tier           admitted <= L(1 + cycles)         == 12  (L=4, 2 cycles)
+  reshard+lease  admitted <= L(1 + H*f + f_h)      == 7   (delivered)
+                 ... + L on loss                   == 11  (lost -> fresh)
+
+Faithfulness notes (scope limits, docs/gubproof.md):
+  * models are single-window — Gregorian/window-reset behavior and
+    cross-generation carve accounting (burn -> expire -> slot-drop ->
+    regrant inside one window) are out of scope;
+  * a violating state is terminal: the explorer reports it and does
+    not expand it further;
+  * `ReshardModel(replay_guard=False)` deliberately removes the
+    `seen_fps` replay guard — the resulting counterexample (a
+    re-delivered Migrate chunk re-inflating a row) is the seeded
+    chaos-plan round-trip fixture in tests/test_gubproof.py.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.gubproof.spec import ProtocolSpec
+
+# An edge reference: (spec_id, machine_name, edge_id, entity).
+EdgeRef = Tuple[str, str, str, Optional[str]]
+# One successor: (action label, fired edges, next state, admitted delta)
+Succ = Tuple[str, Tuple[EdgeRef, ...], tuple]
+
+
+class Model:
+    """Base: a finite transition system tagged with spec edges."""
+
+    name: str = "model"
+    # (spec_id, machine_name) pairs whose every edge must fire.
+    covered: Tuple[Tuple[str, str], ...] = ()
+    # counter name -> exact maximum the closed exploration must reach.
+    expect_max: Dict[str, int] = {}
+    state_cap: int = 400_000
+
+    def __init__(self, specs: Sequence[ProtocolSpec]) -> None:
+        self.specs = {s.id: s for s in specs}
+
+    def initial(self) -> tuple:
+        raise NotImplementedError
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        raise NotImplementedError
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        """None = fine; else the violated-invariant message."""
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        return {}
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        """(spec_id, machine, entity) -> machine state, None = the
+        machine instance does not exist in `s` (creation/deletion is
+        not an edge)."""
+        return {}
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        """(obligation id, applies(state), goal(state)) triples: every
+        reachable state satisfying `applies` must reach a `goal`
+        state."""
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# breaker: closed -> open -> half-open
+# ---------------------------------------------------------------------------
+class BreakerModel(Model):
+    """CircuitConfig scope: failure_threshold=2, half_open_probes=1.
+    State: (state, consecutive_failures, probes, backoff_elapsed)."""
+
+    name = "breaker"
+    T, P = 2, 1
+    covered = (("breaker", "breaker"),)
+    expect_max = {"half_open_probes_admitted": 1}
+
+    def initial(self) -> tuple:
+        return ("closed", 0, 0, 0)
+
+    def _e(self, eid: str) -> Tuple[EdgeRef, ...]:
+        return (("breaker", "breaker", eid, None),)
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        st, cf, probes, elapsed = s
+        ncf = min(cf + 1, self.T)
+        if st == "closed":
+            if ncf >= self.T:
+                yield ("fail:trip", self._e("trip"), ("open", ncf, 0, 0))
+            else:
+                yield ("fail", (), ("closed", ncf, probes, elapsed))
+        elif st == "half_open":
+            yield (
+                "fail:probe_failed", self._e("reopen_probe_fail"),
+                ("open", ncf, 0, 0),
+            )
+        else:  # OPEN: straggler failures neither extend nor double-trip
+            yield ("fail:straggler", (), ("open", ncf, probes, elapsed))
+        if st == "closed":
+            if cf:
+                yield ("success", (), ("closed", 0, probes, elapsed))
+        else:
+            yield ("success:close", self._e("close"), ("closed", 0, 0, 0))
+        if st == "open" and not elapsed:
+            yield ("tick:backoff_expires", (), ("open", cf, probes, 1))
+        if st == "open" and elapsed:
+            # allow() flips to HALF_OPEN and consumes the probe token.
+            yield (
+                "allow:probe", self._e("half_open_entry"),
+                ("half_open", cf, 1, 0),
+            )
+        if st == "half_open" and probes >= self.P:
+            yield (
+                "tick:probe_timeout", self._e("reopen_probe_abandoned"),
+                ("open", cf, 0, 0),
+            )
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        st, _cf, probes, _elapsed = s
+        if probes > self.P:
+            return (
+                f"{probes} probes admitted in one half-open episode "
+                f"(> half_open_probes={self.P})"
+            )
+        if st != "half_open" and probes and st == "open":
+            return "probe tokens outstanding while OPEN"
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        return {"half_open_probes_admitted": s[2]}
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        return {("breaker", "breaker", None): s[0]}
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return (
+            (
+                "breaker-reprobes",
+                lambda s: s[0] == "open",
+                lambda s: s[0] == "half_open",
+            ),
+            (
+                "breaker-recloses",
+                lambda s: True,
+                lambda s: s[0] == "closed",
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# lease: grant/renew/reconcile/release/expire
+# ---------------------------------------------------------------------------
+class LeaseModel(Model):
+    """LeaseConfig scope: limit L=4, fraction 1/4 (allowance a=1),
+    max_holders H=2, two clients.  State:
+    ((hv, local) per client, slot_rem, auth_rem, unreconciled, admitted)
+    where hv is the owner's holder record (A absent / R reserved /
+    V active) and `local` is the holder's unspent local allowance —
+    kept across owner-side expiry: a partitioned holder burns its full
+    unreconciled grant, the bound's worst case."""
+
+    name = "lease"
+    L, H, A = 4, 2, 1
+    SLOT = H * A  # the carve slot's per-window allowance budget
+    covered = (("lease", "holders"),)
+    expect_max = {"admitted": 6}  # L * (1 + H * fraction)
+
+    def initial(self) -> tuple:
+        return ((("A", 0), ("A", 0)), self.SLOT, self.L, 0, 0)
+
+    def _e(self, eid: str, c: int) -> Tuple[EdgeRef, ...]:
+        return (("lease", "holders", eid, f"c{c}"),)
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        holders, slot, auth, unrec, adm = s
+        nonabsent = sum(1 for hv, _l in holders if hv != "A")
+
+        def with_holder(i: int, hv: str, loc: int) -> tuple:
+            hs = list(holders)
+            hs[i] = (hv, loc)
+            return tuple(hs)
+
+        for i, (hv, loc) in enumerate(holders):
+            if hv == "A" and nonabsent < self.H:
+                yield (
+                    f"grant:reserve:c{i}", self._e("reserve", i),
+                    (with_holder(i, "R", loc), slot, auth, unrec, adm),
+                )
+            if hv == "R":
+                if slot >= self.A:
+                    yield (
+                        f"grant:fill:c{i}", self._e("fill", i),
+                        (with_holder(i, "V", self.A), slot - self.A,
+                         auth, unrec, adm),
+                    )
+                # Carve refused (device error / allowance exhausted):
+                # the placeholder is dropped either way.
+                yield (
+                    f"grant:refuse:c{i}", self._e("unreserve", i),
+                    (with_holder(i, "A", loc), slot, auth, unrec, adm),
+                )
+            if hv == "V":
+                yield (
+                    f"reconcile:release:c{i}", self._e("release", i),
+                    (with_holder(i, "A", 0), slot, auth, unrec, adm),
+                )
+                # Expiry keeps the holder's local allowance: the
+                # partitioned holder never saw the sweep.
+                yield (
+                    f"sweep:expire:c{i}", self._e("expire", i),
+                    (with_holder(i, "A", loc), slot, auth, unrec, adm),
+                )
+            if loc > 0:
+                yield (
+                    f"burn:c{i}", (),
+                    (with_holder(i, hv, loc - 1), slot, auth,
+                     min(unrec + 1, self.SLOT), adm + 1),
+                )
+        if auth > 0:
+            yield (
+                "serve:direct", (),
+                (holders, slot, auth - 1, unrec, adm + 1),
+            )
+        if unrec > 0:
+            # queue_hit flush: converges the row, admits nothing.
+            yield (
+                "reconcile:burned_hits", (),
+                (holders, slot, max(auth - 1, 0), unrec - 1, adm),
+            )
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        adm = s[4]
+        bound = self.L + self.SLOT
+        if adm > bound:
+            return (
+                f"admitted {adm} > limit x (1 + max_holders x fraction)"
+                f" = {bound}"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        return {"admitted": s[4]}
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        names = {"A": "absent", "R": "reserved", "V": "active"}
+        return {
+            ("lease", "holders", f"c{i}"): names[hv]
+            for i, (hv, _l) in enumerate(s[0])
+        }
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return ((
+            "lease-collected",
+            lambda s: any(hv != "A" for hv, _l in s[0]),
+            lambda s: all(hv == "A" for hv, _l in s[0]),
+        ),)
+
+
+# ---------------------------------------------------------------------------
+# reshard: PREPARE -> DRAIN -> TRANSFER -> CUTOVER -> RELEASE
+# ---------------------------------------------------------------------------
+# The reshard sub-state shared with the composition model:
+#   (ob, ib, row, rowA, sh, led, fresh, frem, snap)
+#   ob   outbound phase at the old owner A
+#   ib   inbound record at the new owner B: none/prepare/transfer/done
+#   row  where the moved row is: old / wire / new / lost
+#   rowA the row's remaining budget (follows it)
+#   sh   handoff-shadow remaining; led: shadow burns awaiting cutover
+#   fresh/frem  self-cutover created a fresh row at B (lost rows reset)
+#   snap wire snapshot of rowA at extract (broken replay variant only)
+_TERMINAL_OB = ("released", "aborted")
+
+
+def _reshard_succs(
+    rs: tuple, L: int, replay_guard: bool
+) -> Iterable[Tuple[str, Tuple[EdgeRef, ...], tuple, int]]:
+    """Yields (label, edges, next reshard sub-state, admitted delta)."""
+    ob, ib, row, rowA, sh, led, fresh, frem, snap = rs
+
+    def nxt(**kw: object) -> tuple:
+        d = dict(
+            ob=ob, ib=ib, row=row, rowA=rowA, sh=sh, led=led,
+            fresh=fresh, frem=frem, snap=snap,
+        )
+        d.update(kw)
+        return (
+            d["ob"], d["ib"], d["row"], d["rowA"], d["sh"], d["led"],
+            d["fresh"], d["frem"], d["snap"],
+        )
+
+    def e_out(eid: str) -> EdgeRef:
+        return ("reshard", "outbound", eid, None)
+
+    def e_in(eid: str) -> EdgeRef:
+        return ("reshard", "inbound", eid, None)
+
+    if ib == "none" and ob == "prepare":
+        yield ("rpc:prepare", (), nxt(ib="prepare"), 0)
+    if ob == "prepare":
+        if ib == "prepare":
+            yield ("ack:prepare", (e_out("prepare_ack"),), nxt(ob="drain"), 0)
+        yield ("fault:prepare_fail", (e_out("abort"),), nxt(ob="aborted"), 0)
+    if ob == "drain":
+        if ib == "prepare":
+            # One RPC fires both sides: the TRANSFER announcement lands
+            # at B before A's extract+clear.
+            yield (
+                "rpc:transfer",
+                (e_out("transfer_announce"), e_in("ib_transfer")),
+                nxt(ob="transfer", ib="transfer"), 0,
+            )
+        yield ("fault:transfer_fail", (e_out("abort"),), nxt(ob="aborted"), 0)
+    if ob == "transfer":
+        if row == "old":
+            yield (
+                "extract", (),
+                nxt(row="wire", snap=rowA if not replay_guard else 0), 0,
+            )
+        if row == "wire":
+            if ib == "transfer":
+                yield ("deliver", (), nxt(row="new"), 0)
+            yield (
+                "fault:chunk_lost", (e_out("abort"),),
+                nxt(ob="aborted", row="lost"), 0,
+            )
+        if row == "new":
+            yield ("shipped", (e_out("rows_shipped"),), nxt(ob="cutover"), 0)
+            if not replay_guard and ib in ("transfer", "done"):
+                # BROKEN: re-delivered chunk re-injects over the live
+                # row, clobbering consumption back to the wire snapshot.
+                yield ("fault:dup_migrate", (), nxt(rowA=snap), 0)
+    if ob == "cutover":
+        if ib == "transfer":
+            yield (
+                "rpc:cutover", (e_out("release"),),
+                nxt(ob="released", ib="done",
+                    rowA=max(0, rowA - led), led=0, sh=0), 0,
+            )
+        if ib == "done":
+            # Idempotent-accept: the watchdog finalized first; the
+            # sender only needs to know it may release.
+            yield ("rpc:cutover_idem", (e_out("release"),), nxt(ob="released"), 0)
+        yield ("fault:cutover_fail", (e_out("abort"),), nxt(ob="aborted"), 0)
+    if ib in ("prepare", "transfer"):
+        if row == "new":
+            yield (
+                "watchdog:self_cutover", (),
+                nxt(ib="done", rowA=max(0, rowA - led), led=0, sh=0), 0,
+            )
+        else:
+            # Rows that never arrived start fresh: conservative reset,
+            # <= limit, never inflated.
+            yield (
+                "watchdog:self_cutover", (),
+                nxt(ib="done", fresh=1, frem=L, led=0, sh=0), 0,
+            )
+    # -- serving ---------------------------------------------------------
+    if row == "old" and ib in ("none", "prepare") and rowA > 0:
+        # A is still authoritative: B forwards covered checks back
+        # (or the check landed at A directly).
+        yield ("serve:forward_back", (), nxt(rowA=rowA - 1), 1)
+    if row == "old" and ob == "aborted" and rowA > 0:
+        # Aborted pre-extract: A still holds the row and serves
+        # stale-routed checks.
+        yield ("serve:stale_old", (), nxt(rowA=rowA - 1), 1)
+    if ib in ("prepare", "transfer") and sh > 0:
+        # The window's entire double-admission budget.
+        yield (
+            "serve:shadow", (), nxt(sh=sh - 1, led=min(led + 1, 1)), 1,
+        )
+    if ib == "done":
+        if fresh and frem > 0:
+            yield ("serve:fresh", (), nxt(frem=frem - 1), 1)
+        elif not fresh and row == "new" and rowA > 0:
+            yield ("serve:new_owner", (), nxt(rowA=rowA - 1), 1)
+
+
+class ReshardModel(Model):
+    """ReshardConfig scope: one moved key, L=4, handoff_fraction=1/4
+    (shadow limit 1), old owner A -> new owner B.
+    State: (*reshard sub-state, admitted)."""
+
+    name = "reshard"
+    L, SHADOW = 4, 1
+    covered = (("reshard", "outbound"), ("reshard", "inbound"))
+    expect_max = {"admitted_clean": 5, "admitted_lost": 9}
+
+    def __init__(self, specs, replay_guard: bool = True) -> None:
+        super().__init__(specs)
+        self.replay_guard = replay_guard
+        if not replay_guard:
+            self.name = "reshard-no-replay-guard"
+
+    def initial(self) -> tuple:
+        return ("prepare", "none", "old", self.L, self.SHADOW, 0, 0, 0, 0, 0)
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        rs, adm = s[:9], s[9]
+        for label, edges, nrs, dadm in _reshard_succs(
+            rs, self.L, self.replay_guard
+        ):
+            yield (label, edges, nrs + (adm + dadm,))
+
+    def _budget(self, s: tuple) -> int:
+        fresh = s[6]
+        return self.L + self.SHADOW + (self.L if fresh else 0)
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        ob, ib, row, rowA, sh, led, fresh, frem, _snap, adm = s
+        budget = self._budget(s)
+        if adm > budget:
+            kind = "2L + f*L (rows lost)" if fresh else "L x (1 + f)"
+            return f"admitted {adm} > {kind} = {budget}"
+        live = (rowA if row != "lost" else 0) + sh + frem
+        if adm + live > budget:
+            return (
+                f"row inflated: admitted {adm} + live budget {live} > "
+                f"{budget} (conservation: applying burns or injecting "
+                "can only lower remaining)"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        fresh, adm = s[6], s[9]
+        return {
+            "admitted_clean": 0 if fresh else adm,
+            "admitted_lost": adm if fresh else 0,
+        }
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        ob, ib = s[0], s[1]
+        return {
+            ("reshard", "outbound", None): ob,
+            ("reshard", "inbound", None): (
+                ib if ib in ("prepare", "transfer") else None
+            ),
+        }
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return (
+            (
+                "reshard-outbound-terminates",
+                lambda s: s[0] not in _TERMINAL_OB,
+                lambda s: s[0] in _TERMINAL_OB,
+            ),
+            (
+                "reshard-inbound-finalizes",
+                lambda s: s[1] in ("prepare", "transfer"),
+                lambda s: s[1] == "done",
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# tier: hot -> demote -> cold -> promote
+# ---------------------------------------------------------------------------
+class TierModel(Model):
+    """TierConfig scope: one key, L=4, at most 2 demote(-or-restore)/
+    promote cycles.  State:
+    (loc, hot_rem, cold_rem, fresh_consumed, cycles, admitted) —
+    `fresh_consumed` counts hits served from the fresh row while the
+    key is cold-resident (the pre-promote window); migrate_inject
+    merges by subtracting it from the cold row, clamped at zero."""
+
+    name = "tier"
+    L, CYCLES = 4, 2
+    covered = (("tier", "residency"),)
+    expect_max = {"admitted": 12}  # L * (1 + CYCLES)
+
+    def initial(self) -> tuple:
+        return ("hot", self.L, 0, 0, 0, 0)
+
+    def _e(self, eid: str) -> Tuple[EdgeRef, ...]:
+        return (("tier", "residency", eid, None),)
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        loc, hot, cold, fc, cyc, adm = s
+        if loc == "hot":
+            if hot > 0:
+                yield ("serve:hot", (), ("hot", hot - 1, cold, fc, cyc, adm + 1))
+            if cyc < self.CYCLES:
+                yield (
+                    "tick:demote", self._e("demote"),
+                    ("cold", 0, hot, 0, cyc + 1, adm),
+                )
+                # A restart re-inserting the checkpoint's cold rows
+                # widens admission exactly like a demote.
+                yield (
+                    "checkpoint:restore", self._e("restore"),
+                    ("cold", 0, hot, 0, cyc + 1, adm),
+                )
+        if loc == "cold":
+            if fc < self.L:
+                # Cold-resident key served from a fresh row; the NEXT
+                # round sees the merged history.
+                yield (
+                    "serve:cold_miss", (),
+                    ("cold", hot, cold, fc + 1, cyc, adm + 1),
+                )
+            yield (
+                "promote:inject", self._e("promote"),
+                ("hot", max(0, cold - fc), 0, 0, cyc, adm),
+            )
+            # Inject failed twice -> rows conserved back to cold.
+            yield ("promote:conserve", self._e("promote_conserve"), s)
+            yield (
+                "tick:prune_expired", self._e("prune"),
+                ("dropped", 0, 0, fc, cyc, adm),
+            )
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        _loc, _hot, _cold, _fc, cyc, adm = s
+        bound = self.L * (1 + cyc)
+        if adm > bound:
+            return (
+                f"admitted {adm} > limit x (1 + {cyc} demote/promote "
+                f"cycles) = {bound}"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        return {"admitted": s[5]}
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        return {("tier", "residency", None): s[0]}
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return ((
+            "tier-promotes",
+            lambda s: s[0] == "cold",
+            lambda s: s[0] in ("hot", "dropped"),
+        ),)
+
+
+# ---------------------------------------------------------------------------
+# composition: a remap strikes an owner with outstanding leases
+# ---------------------------------------------------------------------------
+class ReshardLeaseModel(Model):
+    """The composition the algebra must close over: the demoted owner A
+    holds outstanding lease grants when the ring remaps the key to B.
+    A's LeaseManager revokes its records (drop_unowned), but partitioned
+    holders keep burning their unreconciled local allowance — the lease
+    bound's worst case — while the handoff window adds its shadow carve.
+
+    Scope: L=4, H=2 holders at allowance 1 each, handoff shadow 1.
+    State: (holders, *reshard sub-state, admitted); each holder is
+    U (never granted) / G (granted, allowance unspent) / B (burned)."""
+
+    name = "reshard_lease"
+    L, H, SHADOW = 4, 2, 1
+    covered = ()  # bounds composition; edge coverage rides the per-plane models
+    expect_max = {"admitted_clean": 7, "admitted_lost": 11}
+    state_cap = 600_000
+
+    def initial(self) -> tuple:
+        return (
+            ("U", "U"),
+            "idle", "none", "old", self.L, self.SHADOW, 0, 0, 0,
+            0,
+        )
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        holders, adm = s[0], s[9]
+        rs = s[1:9] + (0,)  # snap unused (replay guard on)
+        ob = rs[0]
+
+        def with_holder(i: int, hv: str) -> tuple:
+            hs = list(holders)
+            hs[i] = hv
+            return tuple(hs)
+
+        for i, hv in enumerate(holders):
+            if hv == "U" and ob == "idle":
+                # Grants only while A is the undisturbed owner: the
+                # remap revokes records and refuses new grants
+                # (refusal_for: "not the owner of this key").
+                yield (
+                    f"grant:c{i}", (),
+                    (with_holder(i, "G"),) + s[1:9] + (adm,),
+                )
+            if hv == "G":
+                # The partitioned holder burns with zero RPCs — before
+                # or after the remap, reconciled or not.
+                yield (
+                    f"burn:c{i}", (),
+                    (with_holder(i, "B"),) + s[1:9] + (adm + 1,),
+                )
+        if ob == "idle":
+            rowA = s[4]
+            if rowA > 0:
+                yield (
+                    "serve:owner", (),
+                    (holders,) + ("idle",) + s[2:4] + (rowA - 1,)
+                    + s[5:9] + (adm + 1,),
+                )
+            yield (
+                "remap:start", (),
+                (holders, "prepare") + s[2:9] + (adm,),
+            )
+        else:
+            for label, edges, nrs, dadm in _reshard_succs(
+                rs, self.L, True
+            ):
+                yield (
+                    label, edges,
+                    (holders,) + nrs[:8] + (adm + dadm,),
+                )
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        holders, adm = s[0], s[9]
+        row, rowA, sh, fresh, frem = s[3], s[4], s[5], s[7], s[8]
+        budget = self.L + self.H + self.SHADOW + (self.L if fresh else 0)
+        if adm > budget:
+            kind = (
+                "L x (1 + H*f + f_h) + L (rows lost)" if fresh
+                else "L x (1 + H*f + f_h)"
+            )
+            return f"admitted {adm} > {kind} = {budget}"
+        live = (
+            (rowA if row != "lost" else 0) + sh + frem
+            + sum(1 for hv in holders if hv == "G")
+        )
+        if adm + live > budget:
+            return (
+                f"budget inflated: admitted {adm} + outstanding {live} "
+                f"> {budget}"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        fresh, adm = s[7], s[9]
+        return {
+            "admitted_clean": 0 if fresh else adm,
+            "admitted_lost": adm if fresh else 0,
+        }
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        ob, ib = s[1], s[2]
+        return {
+            ("reshard", "outbound", None): (
+                ob if ob != "idle" else None
+            ),
+            ("reshard", "inbound", None): (
+                ib if ib in ("prepare", "transfer") else None
+            ),
+        }
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return ((
+            "composition-quiesces",
+            lambda s: s[1] not in ("idle",) + _TERMINAL_OB
+            or any(hv == "G" for hv in s[0])
+            or s[2] in ("prepare", "transfer"),
+            lambda s: s[1] in ("idle",) + _TERMINAL_OB
+            and not any(hv == "G" for hv in s[0])
+            and s[2] in ("none", "done"),
+        ),)
+
+
+def build_models(specs: Sequence[ProtocolSpec]) -> List[Model]:
+    """The default exploration set: one model per plane spec present,
+    plus the reshard+lease composition when both of its specs are."""
+    ids = {s.id for s in specs}
+    out: List[Model] = []
+    if "breaker" in ids:
+        out.append(BreakerModel(specs))
+    if "lease" in ids:
+        out.append(LeaseModel(specs))
+    if "reshard" in ids:
+        out.append(ReshardModel(specs))
+    if "tier" in ids:
+        out.append(TierModel(specs))
+    if "reshard" in ids and "lease" in ids:
+        out.append(ReshardLeaseModel(specs))
+    return out

@@ -1,0 +1,234 @@
+"""Spec model: the machine-readable protocol description format.
+
+A spec is one JSON document per protocol plane
+(tools/gubproof/specs/<id>.json) declaring
+
+  * one or more state MACHINES — states, initial/terminal sets, and
+    guarded transitions, each transition naming the implementation
+    function(s) allowed to perform it;
+  * the plane's over-admission BOUND — the `admitted <= limit x
+    (1 + plane-factor)` instance this plane proves, with the config
+    knob that sets the factor;
+  * LIVENESS obligations — the "eventually" facts the explorer checks
+    by reverse reachability over the closed small-scope state graph.
+
+Machine kinds (what a "state write" means in the implementation):
+
+  attr   an attribute carrying the state (`self.state`, `ob.phase`),
+         written directly or through a declared setter; transition
+         sites are those writes, resolved through `state_consts`;
+  dict   a container whose membership IS the state (lease holders);
+         transition sites are setitem/delitem/pop/setdefault on it;
+  calls  residency planes with no state variable (the tier): the
+         transitions are calls to declared mover functions
+         (`cold.put_rows`, `cold.pop_rows`), matched by dotted suffix.
+
+The format is deliberately declarative JSON, not Python: specs are
+diffable artifacts a reviewer can read next to docs/*.md prose, and
+the linter/explorer are the only interpreters.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+
+class SpecError(ValueError):
+    """A malformed spec document (fail loudly at load, never at lint)."""
+
+
+@dataclass(frozen=True)
+class Transition:
+    id: str
+    frm: Tuple[str, ...]  # source states ("*" = any)
+    to: str
+    fn: str  # implementation function performing the write
+    event: str = ""
+    guards: Tuple[str, ...] = ()  # identifier terms that must guard fn
+    op: str = ""  # dict machines: setitem|delitem|pop|setdefault
+    call: str = ""  # calls machines: dotted callee suffix
+
+
+@dataclass
+class Machine:
+    name: str
+    kind: str  # "attr" | "dict" | "calls"
+    owner_class: str
+    states: Tuple[str, ...]
+    initial: str
+    terminal: Tuple[str, ...]
+    transitions: List[Transition]
+    state_attr: str = ""  # attr/dict kinds: the attribute/container
+    setter: str = ""  # attr kind: a transition helper method
+    receivers: Tuple[str, ...] = ()  # attr kind: receiver vars to bind
+    state_consts: Dict[str, str] = field(default_factory=dict)
+    watched_calls: Tuple[str, ...] = ()  # calls kind: site universe
+
+    def transition_pairs(self) -> set:
+        """(from, to) pairs the machine declares — the explorer's
+        conformance oracle."""
+        out = set()
+        for t in self.transitions:
+            srcs = self.states if t.frm == ("*",) else t.frm
+            for s in srcs:
+                out.add((s, t.to))
+        return out
+
+
+@dataclass
+class Bound:
+    formula: str  # e.g. "limit x (1 + max_holders x fraction)"
+    factor: str  # prose: what the plane-factor is
+    config: str  # the knob(s) that set it
+
+
+@dataclass
+class Liveness:
+    id: str
+    text: str
+
+
+@dataclass
+class ProtocolSpec:
+    id: str
+    title: str
+    module: str  # repo-relative implementation module
+    doc: str  # the prose proof this spec mechanizes
+    bound: Bound
+    liveness: List[Liveness]
+    machines: List[Machine]
+    path: Path  # where the spec was loaded from
+
+    def machine(self, name: str) -> Machine:
+        for m in self.machines:
+            if m.name == name:
+                return m
+        raise KeyError(name)
+
+
+def _req(d: dict, key: str, where: str) -> object:
+    if key not in d:
+        raise SpecError(f"{where}: missing required field {key!r}")
+    return d[key]
+
+
+def _load_machine(d: dict, where: str) -> Machine:
+    name = _req(d, "name", where)
+    where = f"{where}.{name}"
+    kind = _req(d, "kind", where)
+    if kind not in ("attr", "dict", "calls"):
+        raise SpecError(f"{where}: unknown machine kind {kind!r}")
+    states = tuple(_req(d, "states", where))
+    initial = _req(d, "initial", where)
+    terminal = tuple(d.get("terminal", ()))
+    if initial not in states:
+        raise SpecError(f"{where}: initial state {initial!r} not in states")
+    for t in terminal:
+        if t not in states:
+            raise SpecError(f"{where}: terminal state {t!r} not in states")
+    transitions: List[Transition] = []
+    seen_ids = set()
+    for td in _req(d, "transitions", where):
+        tid = _req(td, "id", where)
+        if tid in seen_ids:
+            raise SpecError(f"{where}: duplicate transition id {tid!r}")
+        seen_ids.add(tid)
+        frm = tuple(td.get("from", ("*",)))
+        to = _req(td, "to", f"{where}.{tid}")
+        for s in frm:
+            if s != "*" and s not in states:
+                raise SpecError(
+                    f"{where}.{tid}: source state {s!r} not in states"
+                )
+        if to not in states:
+            raise SpecError(
+                f"{where}.{tid}: target state {to!r} not in states"
+            )
+        transitions.append(Transition(
+            id=tid, frm=frm, to=to,
+            fn=_req(td, "fn", f"{where}.{tid}"),
+            event=td.get("event", ""),
+            guards=tuple(td.get("guards", ())),
+            op=td.get("op", ""),
+            call=td.get("call", ""),
+        ))
+    m = Machine(
+        name=name, kind=kind,
+        owner_class=d.get("owner_class", ""),
+        states=states, initial=initial, terminal=terminal,
+        transitions=transitions,
+        state_attr=d.get("state_attr", ""),
+        setter=d.get("setter", ""),
+        receivers=tuple(d.get("receivers", ())),
+        state_consts=dict(d.get("state_consts", {})),
+        watched_calls=tuple(d.get("watched_calls", ())),
+    )
+    if kind in ("attr", "dict") and not m.state_attr:
+        raise SpecError(f"{where}: {kind} machine needs state_attr")
+    if kind == "attr":
+        for const, st in m.state_consts.items():
+            if st not in states:
+                raise SpecError(
+                    f"{where}: state_consts[{const!r}] -> unknown "
+                    f"state {st!r}"
+                )
+        for t in transitions:
+            if t.op or t.call:
+                raise SpecError(
+                    f"{where}.{t.id}: attr transitions take no op/call"
+                )
+    if kind == "dict":
+        for t in transitions:
+            if t.op not in ("setitem", "delitem", "pop", "setdefault"):
+                raise SpecError(
+                    f"{where}.{t.id}: dict transition needs op in "
+                    "setitem|delitem|pop|setdefault"
+                )
+    if kind == "calls":
+        if not m.watched_calls:
+            raise SpecError(f"{where}: calls machine needs watched_calls")
+        for t in transitions:
+            if t.call not in m.watched_calls:
+                raise SpecError(
+                    f"{where}.{t.id}: call {t.call!r} not in "
+                    "watched_calls"
+                )
+    return m
+
+
+def load_spec(path: Path) -> ProtocolSpec:
+    """Load and validate one spec document."""
+    try:
+        d = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        raise SpecError(f"{path}: unreadable spec: {e}") from e
+    where = path.name
+    sid = _req(d, "id", where)
+    bd = _req(d, "bound", where)
+    bound = Bound(
+        formula=_req(bd, "formula", f"{where}.bound"),
+        factor=bd.get("factor", ""),
+        config=bd.get("config", ""),
+    )
+    liveness = [
+        Liveness(id=_req(ld, "id", f"{where}.liveness"),
+                 text=_req(ld, "text", f"{where}.liveness"))
+        for ld in d.get("liveness", ())
+    ]
+    machines = [
+        _load_machine(md, where) for md in _req(d, "machines", where)
+    ]
+    if not machines:
+        raise SpecError(f"{where}: a spec needs at least one machine")
+    return ProtocolSpec(
+        id=sid,
+        title=_req(d, "title", where),
+        module=_req(d, "module", where),
+        doc=d.get("doc", ""),
+        bound=bound,
+        liveness=liveness,
+        machines=machines,
+        path=path,
+    )
