@@ -116,6 +116,21 @@ def _node_lines(addr: str, v: Dict) -> List[str]:
                 f" DROPS={drops}" if drops else "",
             )
         )
+    load = v.get("load")
+    if load:
+        # A gubload scenario phase is driving this node right now —
+        # the operator can tie any latency blip to its phase.
+        since = load.get("since")
+        age_s = (
+            " t+%.1fs" % (time.time() - since)
+            if isinstance(since, (int, float)) else ""
+        )
+        lines.append(
+            "    load: scenario=%s phase=%s seq=%s%s" % (
+                load.get("scenario", "?"), load.get("phase", "?"),
+                load.get("seq", "?"), age_s,
+            )
+        )
     return lines
 
 

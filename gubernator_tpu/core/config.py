@@ -454,6 +454,59 @@ def stats_config_from_env() -> StatsConfig:
 
 
 @dataclass
+class LoadConfig:
+    """Gubload — the open-loop scenario harness (loadgen/;
+    docs/loadgen.md; no reference analog — the Go repo benchmarks
+    closed-loop).  Parsed by the gubload CLI and scripts/load_smoke.py,
+    never by the daemon: the knobs shape the LOAD, not the server.
+
+    `seed` drives every arrival timestamp and key draw (identical
+    seeds reproduce identical schedules across runs and worker
+    counts).  `duration_s` stretches the named scenario's phases to
+    this total; `clients` bounds the connection fan-out; `target_rps`
+    is the peak arrival rate the schedules are planned at."""
+
+    seed: int = 1337
+    scenario: str = "steady"
+    duration_s: float = 6.0
+    clients: int = 8
+    target_rps: float = 400.0
+
+    def __post_init__(self) -> None:
+        if not self.scenario:
+            raise ValueError("load scenario must be non-empty")
+        if self.duration_s <= 0:
+            raise ValueError(
+                f"load duration_s must be > 0, got {self.duration_s}"
+            )
+        _require_min("load clients", self.clients, 1)
+        if self.target_rps <= 0:
+            raise ValueError(
+                f"load target_rps must be > 0, got {self.target_rps}"
+            )
+
+
+def load_config_from_env() -> LoadConfig:
+    """The gubload plane's env parse (same contract as
+    hotkey_config_from_env): validation errors name the env surface at
+    startup instead of crashing a constructor later."""
+    try:
+        return LoadConfig(
+            seed=_env_int("GUBER_LOAD_SEED", 1337),
+            scenario=_env("GUBER_LOAD_SCENARIO", "steady"),
+            duration_s=_env_float_s("GUBER_LOAD_DURATION", 6.0),
+            clients=_env_int("GUBER_LOAD_CLIENTS", 8),
+            target_rps=float(_env("GUBER_LOAD_TARGET_RPS", "400")),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "load env config (GUBER_LOAD_SEED, GUBER_LOAD_SCENARIO, "
+            "GUBER_LOAD_DURATION, GUBER_LOAD_CLIENTS, "
+            f"GUBER_LOAD_TARGET_RPS): {e}"
+        ) from None
+
+
+@dataclass
 class TierConfig:
     """Guberberg — the two-tier key table (runtime/coldtier.py;
     docs/tiering.md; no reference analog — the Go daemon's cache IS

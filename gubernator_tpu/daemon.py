@@ -288,6 +288,10 @@ class Daemon:
 
         self.flightrec = recorder_from_config(self.conf, self.metrics)
         self.metrics.flightrec = self.flightrec
+        # gubload phase attribution (loadgen/engine.py PhaseTracker):
+        # {"scenario", "phase", "seq", "since"} while a load-scenario
+        # phase is driving this node, None otherwise.
+        self.load_status: Optional[dict] = None
         # AutoTLS certs must carry the advertise host in their SANs or
         # cross-host peer dials fail hostname verification.
         adv_host = (
@@ -876,6 +880,8 @@ class Daemon:
                 "loop_lag_ms_max": round(fr.max_lag_ms, 2),
                 "last_dump_path": fr.last_dump_path,
             }
+        if self.load_status is not None:
+            out["load"] = dict(self.load_status)
         return web.json_response(out)
 
     @staticmethod

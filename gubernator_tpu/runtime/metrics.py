@@ -18,7 +18,8 @@ a latency estimate (the PromQL histogram_quantile interpolation).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 from prometheus_client import (
     CollectorRegistry,
@@ -65,6 +66,125 @@ def estimate_quantile(
             return prev_bound + (bound - prev_bound) * frac
         prev_bound, prev_count = bound, c
     return float(buckets[-1])
+
+
+class HdrRecorder:
+    """Exact log-linear (HDR) latency recorder for the open-loop load
+    harness (gubernator_tpu/loadgen; docs/loadgen.md).
+
+    Values are quantized to 1µs units and bucketed log-linearly: 256
+    sub-buckets per power of two, so every recorded value lands in a
+    bucket whose width is at most value/128 and the bucket-midpoint
+    estimate is within 1/256 (~0.4%) of the true value — comfortably
+    inside the advertised ~1% relative error at any percentile.  Unlike
+    the daemon's fixed LATENCY_BUCKETS histograms (16 buckets, built
+    for cheap hot-path observation), this recorder is built for
+    *reporting*: p999 of a million samples never interpolates across a
+    4x-wide bucket.
+
+    Merging is elementwise count addition, so it is commutative and
+    associative: shards recorded by independent workers merge to the
+    same state in any order (the schedule-determinism contract in
+    tests/test_loadgen.py), and `to_dict`/`from_dict` round-trip the
+    state across process boundaries for multi-worker runs.
+
+    Thread-safe: `record` may be called from any worker thread.  The
+    lock is a leaf (registered as loadgen.hdr._lock in the gubguard
+    lock ranking) — nothing else is ever acquired while holding it.
+    """
+
+    UNIT_S = 1e-6           # 1µs resolution
+    _SUB_BITS = 8           # 256 sub-buckets per power of two
+    _SUB = 1 << _SUB_BITS
+    _SUB_HALF = _SUB >> 1
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self._total = 0
+
+    # -- recording ----------------------------------------------------
+
+    @classmethod
+    def _index(cls, units: int) -> int:
+        bucket = max(0, units.bit_length() - cls._SUB_BITS)
+        sub = units >> bucket
+        return (bucket + 1) * cls._SUB_HALF + (sub - cls._SUB_HALF)
+
+    @classmethod
+    def _value_s(cls, index: int) -> float:
+        """Midpoint of the bucket `index`, in seconds."""
+        if index < cls._SUB:
+            bucket, sub = 0, index
+        else:
+            bucket = (index >> (cls._SUB_BITS - 1)) - 1
+            sub = cls._SUB_HALF + (index & (cls._SUB_HALF - 1))
+        low = sub << bucket
+        return (low + (1 << bucket) * 0.5) * cls.UNIT_S
+
+    def record(self, value_s: float) -> None:
+        """One latency sample in seconds (values < 1µs clamp to 1µs)."""
+        units = max(1, int(value_s / self.UNIT_S + 0.5))
+        idx = self._index(units)
+        with self._lock:
+            self._counts[idx] = self._counts.get(idx, 0) + 1
+            self._total += 1
+
+    # -- reading ------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    def percentile(self, q: float) -> float:
+        """Value at quantile `q` in [0, 1], in seconds (0.0 if empty)."""
+        with self._lock:
+            items = sorted(self._counts.items())
+            total = self._total
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for idx, n in items:
+            cum += n
+            if cum >= rank:
+                return self._value_s(idx)
+        return self._value_s(items[-1][0])
+
+    def percentiles(self, qs: Iterable[float]) -> Tuple[float, ...]:
+        return tuple(self.percentile(q) for q in qs)
+
+    # -- merging / serialization --------------------------------------
+
+    def merge(self, other: "HdrRecorder") -> "HdrRecorder":
+        with other._lock:
+            snap = dict(other._counts)
+        with self._lock:
+            for idx, n in snap.items():
+                self._counts[idx] = self._counts.get(idx, 0) + n
+                self._total += n
+        return self
+
+    def to_dict(self) -> Dict:
+        with self._lock:
+            return {
+                "unit_s": self.UNIT_S,
+                "sub_bits": self._SUB_BITS,
+                "counts": {str(k): v for k, v in self._counts.items()},
+            }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "HdrRecorder":
+        if d.get("sub_bits") != cls._SUB_BITS:
+            raise ValueError(
+                f"HdrRecorder layout mismatch: sub_bits "
+                f"{d.get('sub_bits')} != {cls._SUB_BITS}"
+            )
+        h = cls()
+        for k, v in (d.get("counts") or {}).items():
+            h._counts[int(k)] = int(v)
+            h._total += int(v)
+        return h
 
 
 class Metrics:
@@ -574,6 +694,19 @@ class Metrics:
             "Cumulative promote-latency histogram on the shared "
             "LATENCY_BUCKETS (seconds from cold hit to merged inject).",
             ["le"],
+            registry=r,
+        )
+
+        # -- gubload: open-loop scenario harness (loadgen/;
+        #    docs/loadgen.md).  Set by the harness's phase tracker when
+        #    a scenario drives this node in-process; labels are removed
+        #    at phase exit so an idle daemon exports nothing here.
+        self.load_active = Gauge(
+            "gubernator_load_active",
+            "A gubload scenario phase currently driving this node "
+            "(1 while the phase is active; the label pair is removed "
+            "at phase exit).",
+            ["scenario", "phase"],
             registry=r,
         )
 

@@ -3,7 +3,9 @@
 5's down payment — a slow PR fails loudly instead of drifting).
 
 Compares the NEW artifact's per-config p50 against the BASELINE's on
-MATCHING keys — (config, serve_mode, concurrency) — and fails (exit 1)
+MATCHING keys — (config, serve_mode, concurrency) for bench_e2e rows,
+plus (scenario, phase, platform) for gubload scenario rows (a scenario
+key with no baseline warns instead of failing) — and fails (exit 1)
 when any matched config's p50 regressed by more than --threshold
 (default 25%).  Throughput (checks_per_sec) regressions past the same
 threshold are reported as warnings: p50 is the gate (the tail is what
@@ -48,12 +50,19 @@ _SKIP_CONFIGS = {
 
 
 def _key(line: dict):
+    # Scenario rows (gubload artifacts, config == "load_scenario")
+    # extend the key with (scenario, phase, platform): each phase of
+    # each scenario gates independently, and a row only ever matches a
+    # baseline recorded on the same hardware.
     return (
         line.get("config"),
         line.get("serve_mode"),
         line.get("pipeline_depth"),
         line.get("client_mode"),
         line.get("concurrency"),
+        line.get("scenario"),
+        line.get("phase"),
+        line.get("platform"),
     )
 
 
@@ -116,6 +125,16 @@ def gate(baseline: dict, new: dict, threshold: float,
     matched = sorted(
         set(base_lines) & set(new_lines), key=lambda k: str(k)
     )
+    # A scenario key with no baseline is a NEW scenario (or a platform
+    # change): its first artifact becomes the baseline for the next
+    # round — warn, never fail (there is nothing to regress against).
+    for k in sorted(set(new_lines) - set(base_lines), key=str):
+        if new_lines[k].get("scenario"):
+            label = "/".join(str(p) for p in k if p is not None)
+            print(
+                f"bench_gate: WARN new scenario key {label}: no "
+                "baseline — recorded for the next round, not gated"
+            )
     if not matched:
         print("bench_gate: no matching (config, mode) keys — nothing "
               "to gate (artifact schema drift?)")

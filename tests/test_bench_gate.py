@@ -118,6 +118,69 @@ def test_throughput_drop_is_warning_not_failure(capsys):
     assert "throughput" in capsys.readouterr().out
 
 
+def _load_artifact(platform="cpu", p50=20.0, scenario="flashcrowd",
+                   phase="crowd"):
+    return {
+        "platform": platform,
+        "results": [
+            {
+                "config": "load_scenario",
+                "scenario": scenario, "phase": phase,
+                "platform": platform,
+                "p50_ms": p50, "p99_ms": p50 * 3, "p999_ms": p50 * 5,
+                "checks_per_sec": 300.0, "arrivals": 1000,
+                "send_skew_p99_ms": 1.0, "open_loop": True,
+            },
+        ],
+    }
+
+
+def test_scenario_keys_gate_per_phase():
+    """gubload rows key on (scenario, phase, platform): the same
+    scenario+phase gates p50 like any bench config..."""
+    assert bench_gate.gate(
+        _load_artifact(p50=20.0), _load_artifact(p50=80.0), 0.25, False
+    ) == 1
+    assert bench_gate.gate(
+        _load_artifact(p50=20.0), _load_artifact(p50=21.0), 0.25, False
+    ) == 0
+
+
+def test_scenario_phase_keys_disjoint():
+    """...while different phases of the same scenario never
+    cross-compare (a storm phase's tail is not a warm phase's
+    regression)."""
+    assert bench_gate.gate(
+        _load_artifact(phase="warm", p50=5.0),
+        _load_artifact(phase="crowd", p50=500.0),
+        0.25, False,
+    ) == 0
+
+
+def test_new_scenario_warns_not_fails(capsys):
+    """A scenario key with no baseline must WARN and exit 0: its first
+    artifact BECOMES the baseline — a new scenario must not brick the
+    gate for the PR that introduces it."""
+    base = _artifact(p50=10.0)  # no scenario rows at all
+    new = _artifact(p50=10.0)
+    new["results"].extend(_load_artifact(p50=500.0)["results"])
+    assert bench_gate.gate(base, new, 0.25, False) == 0
+    out = capsys.readouterr().out
+    assert "new scenario key" in out and "WARN" in out
+    assert "FAIL" not in out
+
+
+def test_scenario_platform_in_key_prevents_cross_hw_gating():
+    """A cpu-recorded scenario row must not gate a tpu recording even
+    when the artifacts' top-level platforms were somehow equal — the
+    per-row platform is part of the key."""
+    base = _load_artifact(platform="cpu", p50=5.0)
+    new = _load_artifact(platform="cpu", p50=5.0)
+    new["results"][0]["platform"] = "tpu"
+    new["results"][0]["p50_ms"] = 500.0
+    assert bench_gate.gate(base, new, 0.25, False) == 0
+
+
 def test_find_latest_pair(tmp_path):
     for n in (3, 9, 10):
         (tmp_path / f"BENCH_E2E_r{n:02d}.json").write_text("{}")

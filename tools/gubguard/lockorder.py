@@ -49,6 +49,7 @@ CLASS_LOCK_MAP = {
     ("ReshardManager", "_lock"): "reshard._lock",
     ("ColdTier", "_lock"): "coldtier._lock",
     ("TenantAccounting", "_lock"): "gubstat._lock",
+    ("HdrRecorder", "_lock"): "loadgen.hdr._lock",
     ("FlightRecorder", "_lock"): "flightrec._lock",
     ("_TraceState", "_lock"): "tracing._lock",
     ("MemorySpanExporter", "_lock"): "tracing.exporter._lock",
@@ -152,6 +153,12 @@ RANK = {
     # takes nothing while held (name decode closures touch no locks).
     "gubstat._lock": 59,
     "flightrec._lock": 60,
+    # loadgen.hdr._lock (runtime/metrics.py HdrRecorder bucket counts)
+    # is a leaf: record()/percentile()/merge() guard only the counts
+    # dict and take nothing while held — merge() snapshots the OTHER
+    # recorder's counts under its lock FIRST, releases, then takes its
+    # own, so two merges never hold both locks at once.
+    "loadgen.hdr._lock": 62,
     # tracing._lock (runtime/tracing.py counters/recent ring) ranks with
     # flightrec: span bookkeeping may run under ANY layer's lock (a span
     # ends inside a locked merge), and the tracing plane never takes
