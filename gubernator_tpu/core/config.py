@@ -1041,6 +1041,21 @@ def gubproof_depth_from_env() -> Optional[int]:
     return None if d <= 0 else d
 
 
+def gubrange_dump_dir_from_env() -> str:
+    """Where `python -m tools.gubrange` writes failing kernels'
+    interval-analysis dumps (seeded bounds, issues, witness — CI
+    uploads the directory as the failure artifact).  Same discipline
+    as gubtrace_dump_dir_from_env."""
+    return _env("GUBRANGE_DUMP_DIR", "gubrange-dumps")
+
+
+def gubrange_strict_from_env() -> bool:
+    """Whether gubrange treats warnings (unknown primitives, slack
+    budgets) as errors without the --strict flag — CI sets it so a
+    transfer-function gap can never silently widen the analysis."""
+    return _env("GUBRANGE_STRICT", "false").lower() in ("1", "true", "yes")
+
+
 def fastpath_sparse_from_env() -> int:
     """The sparse-overlap drain knob, parsed/validated exactly as the
     daemon does — the public entry for harnesses (bench_e2e) that build

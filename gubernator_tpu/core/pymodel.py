@@ -56,6 +56,28 @@ def _trunc(x: float) -> int:
     return int(math.trunc(x))
 
 
+def _clamp_i64(x: int) -> int:
+    if x > _I64_MAX:
+        return _I64_MAX
+    if x < _I64_MIN:
+        return _I64_MIN
+    return x
+
+
+def _sat_add(a: int, b: int) -> int:
+    """Saturating int64 add — the oracle half of the device's
+    _sat_add_i64 (ops/step.py).  The device clamps the addend into the
+    room the augend leaves, which equals clamping the exact
+    unbounded-int sum; composed saturating ops must still clamp STEP BY
+    STEP in the same order as the device, not clamp one exact total."""
+    return _clamp_i64(a + b)
+
+
+def _sat_sub(a: int, b: int) -> int:
+    """Saturating int64 subtract (see _sat_add)."""
+    return _clamp_i64(a - b)
+
+
 class PyRateLimiter:
     """Sequential, exact rate limiter over a dict cache."""
 
@@ -95,9 +117,15 @@ class PyRateLimiter:
                 del self.cache[key]
                 return self._token_bucket_new(r, now)
 
-            # Limit change (algorithms.go:112-119).
+            # Limit change (algorithms.go:112-119).  Saturating like the
+            # device (step-by-step: add, then sub).
             if item.limit != r.limit:
-                item.remaining = max(item.remaining + r.limit - item.limit, 0)
+                item.remaining = max(
+                    _sat_sub(
+                        _sat_add(int(item.remaining), r.limit), item.limit
+                    ),
+                    0,
+                )
                 item.limit = r.limit
 
             rl = RateLimitResp(
@@ -112,10 +140,10 @@ class PyRateLimiter:
                 if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
                     expire = gregorian_expiration(self.clock.now(), r.duration)
                 else:
-                    expire = item.created_at + r.duration
+                    expire = _sat_add(item.created_at, r.duration)
                 if expire <= now:
                     # Renew (algorithms.go:141-147).
-                    expire = now + r.duration
+                    expire = _sat_add(now, r.duration)
                     item.created_at = now
                     item.remaining = item.limit
                 item.expire_at = expire
@@ -156,7 +184,7 @@ class PyRateLimiter:
         if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
             expire = gregorian_expiration(self.clock.now(), r.duration)
         else:
-            expire = now + r.duration
+            expire = _sat_add(now, r.duration)
         remaining = r.limit - r.hits
         rl = RateLimitResp(
             status=Status.UNDER_LIMIT,
@@ -225,7 +253,7 @@ class PyRateLimiter:
             duration = gregorian_expiration(self.clock.now(), r.duration) - now
 
         if r.hits != 0:
-            item.expire_at = now + duration  # algorithms.go:363-365
+            item.expire_at = _sat_add(now, duration)  # algorithms.go:363-365
 
         # Leak (algorithms.go:367-378).
         elapsed = now - item.created_at
@@ -238,11 +266,17 @@ class PyRateLimiter:
 
         rem_i = _trunc(rem)
         rate_i = _trunc(rate)
+        # ResetTime in float64 + saturating truncation, mirroring the
+        # device's evaluation order exactly (ops/step.py le_resp_reset):
+        # exact below 2^53, saturates instead of wrapping beyond int64.
         rl = RateLimitResp(
             status=Status.UNDER_LIMIT,
             limit=item.limit,
             remaining=rem_i,
-            reset_time=now + (item.limit - rem_i) * rate_i,
+            reset_time=_trunc(
+                float(now) + (float(item.limit) - float(rem_i))
+                * float(rate_i)
+            ),
         )
 
         if rem_i == 0 and r.hits > 0:
@@ -256,7 +290,9 @@ class PyRateLimiter:
             rem -= float(r.hits)
             item.remaining = rem
             rl.remaining = 0
-            rl.reset_time = now + (rl.limit - 0) * rate_i
+            rl.reset_time = _trunc(
+                float(now) + (float(rl.limit) - 0.0) * float(rate_i)
+            )
             return rl
 
         if r.hits > rem_i:
@@ -274,7 +310,10 @@ class PyRateLimiter:
         rem -= float(r.hits)
         item.remaining = rem
         rl.remaining = _trunc(rem)
-        rl.reset_time = now + (rl.limit - rl.remaining) * rate_i
+        rl.reset_time = _trunc(
+            float(now) + (float(rl.limit) - float(rl.remaining))
+            * float(rate_i)
+        )
         return rl
 
     def _leaky_bucket_new(
@@ -295,18 +334,23 @@ class PyRateLimiter:
             status=Status.UNDER_LIMIT,
             limit=r.limit,
             remaining=burst - r.hits,
-            reset_time=now + (r.limit - (burst - r.hits)) * rate_i,
+            reset_time=_trunc(
+                float(now) + (float(r.limit) - float(burst - r.hits))
+                * float(rate_i)
+            ),
         )
         if r.hits > burst:
             # algorithms.go:470-476.
             rl.status = Status.OVER_LIMIT
             rl.remaining = 0
-            rl.reset_time = now + (rl.limit - 0) * rate_i
+            rl.reset_time = _trunc(
+                float(now) + (float(rl.limit) - 0.0) * float(rate_i)
+            )
             rem = 0.0
         self.cache[r.hash_key()] = CacheItem(
             key=r.hash_key(),
             algorithm=Algorithm.LEAKY_BUCKET,
-            expire_at=now + duration,
+            expire_at=_sat_add(now, duration),
             limit=r.limit,
             duration=duration,  # stored as the COMPUTED duration here
             remaining=rem,

@@ -113,6 +113,46 @@ def _trunc_i64(x: jax.Array) -> jax.Array:
     return x.astype(jnp.int64)
 
 
+def _sat_add_i64(a: jax.Array, b: jax.Array) -> jax.Array:
+    """int64 a+b with two's-complement wrap replaced by saturation.
+
+    Equivalent to clamping the exact unbounded-int sum, which is what
+    the oracle mirror (core/pymodel.py _sat_add) computes — the
+    differential suite holds the two bit-identical at the int64 corners
+    (tests/test_gubrange.py).  Construction: clamp `b` into the room
+    `a` leaves before the bound, then add — NO intermediate ever wraps
+    (`max(a,0) ∈ [0,MAX]` so `MAX - max(a,0) ∈ [0,MAX]`, and the final
+    sum is confined to [MIN,MAX] by the clip), which keeps the gubrange
+    interval walk exact instead of a wrap-then-repair select that joins
+    to the full int64 range.  Guards the expire/reset epoch math
+    against hostile wire durations (the reference wraps silently here,
+    algorithms.go:141 `now + r.Duration`); gubrange proves in-envelope
+    inputs never come near saturation.
+    """
+    hi = jnp.int64(2**63 - 1)
+    lo = jnp.int64(-(2**63))
+    zero = jnp.int64(0)
+    room_hi = hi - jnp.maximum(a, zero)
+    room_lo = lo - jnp.minimum(a, zero)
+    return a + jnp.clip(b, room_lo, room_hi)
+
+
+def _sat_sub_i64(a: jax.Array, b: jax.Array) -> jax.Array:
+    """int64 a-b saturating at the bounds (see _sat_add_i64).
+
+    The subtrahend is clamped into [a-MAX, a-MIN] before subtracting;
+    when a constraint endpoint is unrepresentable the corresponding
+    clip bound degenerates to MIN/MAX (vacuous), so nothing wraps:
+    `max(a,-1) - MAX ∈ [MIN,0]` and `min(a,-1) - MIN ∈ [0,MAX]`.
+    """
+    hi = jnp.int64(2**63 - 1)
+    lo = jnp.int64(-(2**63))
+    neg1 = jnp.int64(-1)
+    b_lo = jnp.maximum(a, neg1) - hi
+    b_hi = jnp.minimum(a, neg1) - lo
+    return a - jnp.clip(b, b_lo, b_hi)
+
+
 def _first_claim(tgt: jax.Array, attempt: jax.Array) -> jax.Array:
     """Of all lanes attempting the same target slot, the lowest lane wins.
 
@@ -263,13 +303,16 @@ def apply_batch_impl(
     # ==== token bucket, existing item (algorithms.go:112-195) ===========
     limit_changed = s_limit != r_lim
     rem0 = jnp.where(
-        limit_changed, jnp.maximum(s_rem + r_lim - s_limit, 0), s_rem
+        limit_changed,
+        jnp.maximum(_sat_sub_i64(_sat_add_i64(s_rem, r_lim), s_limit), 0),
+        s_rem,
     )
     dur_changed = s_dur != r_dur
-    expire1 = jnp.where(is_greg, greg_exp, s_t0 + r_dur)
+    expire1 = jnp.where(is_greg, greg_exp, _sat_add_i64(s_t0, r_dur))
     renew = dur_changed & (expire1 <= now)
     te_expire = jnp.where(
-        dur_changed, jnp.where(renew, now + r_dur, expire1), s_expire
+        dur_changed, jnp.where(renew, _sat_add_i64(now, r_dur), expire1),
+        s_expire,
     )
     te_t0 = jnp.where(renew, now, s_t0)
     rem1 = jnp.where(renew, r_lim, rem0)
@@ -291,7 +334,7 @@ def apply_batch_impl(
     # ==== token bucket, new item (algorithms.go:203-258) ================
     tn_over = r_hits > r_lim
     tn_rem = jnp.where(tn_over, r_lim, r_lim - r_hits)
-    tn_expire = jnp.where(is_greg, greg_exp, now + r_dur)
+    tn_expire = jnp.where(is_greg, greg_exp, _sat_add_i64(now, r_dur))
     tn_resp_status = jnp.where(tn_over, OVER, UNDER)
 
     # ==== leaky bucket, existing item (algorithms.go:327-426) ===========
@@ -305,7 +348,9 @@ def apply_batch_impl(
         0.0,
         jnp.where(is_greg, _f64(greg_dur), _f64(r_dur)) / _f64(safe_lim),
     )
-    le_expire = jnp.where(r_hits != 0, now + l_dur_c, s_expire)
+    # l_dur_c may be negative under Gregorian (greg_exp already passed);
+    # saturating add keeps a hostile wire expiry from wrapping the epoch.
+    le_expire = jnp.where(r_hits != 0, _sat_add_i64(now, l_dur_c), s_expire)
     elapsed = _f64(now - s_t0)
     leak = jnp.where(l_rate != 0.0, elapsed / l_rate, 0.0)
     leaked = _trunc_i64(leak) > 0
@@ -323,11 +368,19 @@ def apply_batch_impl(
     le_resp_rem = jnp.where(
         l_exact, 0, jnp.where(l_take, _trunc_i64(lb4), lrem_i)
     )
-    le_resp_reset = jnp.where(
+    # ResetTime = now + (limit - remaining) * rate computed in float64 and
+    # truncated through the _trunc_i64 saturation contract: exact below
+    # 2^53 (every realistic envelope), saturating instead of wrapping for
+    # hostile wire limits/durations.  The oracle mirrors the same
+    # float64 evaluation order bit-for-bit (core/pymodel.py).
+    f_now = _f64(now)
+    f_lim = _f64(r_lim)
+    f_lrate = _f64(lrate_i)
+    le_resp_reset = _trunc_i64(jnp.where(
         l_take,
-        now + (r_lim - le_resp_rem) * lrate_i,
-        now + (r_lim - lrem_i) * lrate_i,
-    )
+        f_now + (f_lim - _f64(le_resp_rem)) * f_lrate,
+        f_now + (f_lim - _f64(lrem_i)) * f_lrate,
+    ))
     le_resp_status = jnp.where(l_over_zero | l_over_more, OVER, UNDER)
 
     # ==== leaky bucket, new item (algorithms.go:433-492) ================
@@ -340,9 +393,11 @@ def apply_batch_impl(
     ln_over = r_hits > r_burst
     ln_rem_f = jnp.where(ln_over, 0.0, _f64(r_burst - r_hits))
     ln_resp_rem = jnp.where(ln_over, 0, r_burst - r_hits)
-    ln_resp_reset = now + (r_lim - ln_resp_rem) * ln_rate_i
+    ln_resp_reset = _trunc_i64(
+        f_now + (f_lim - _f64(ln_resp_rem)) * _f64(ln_rate_i)
+    )
     ln_resp_status = jnp.where(ln_over, OVER, UNDER)
-    ln_expire = now + ln_dur
+    ln_expire = _sat_add_i64(now, ln_dur)
 
     # ==== select per-lane outputs =======================================
     tok_new = is_new & req_token

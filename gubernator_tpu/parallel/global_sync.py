@@ -187,16 +187,26 @@ def make_global_sync_step_psum(mesh, ways: int):
 
     def _local(auth: SlotTable, cache: SlotTable, delta: DeltaGrid, now):
         d = DeltaGrid(*[a[0] for a in delta])  # local [n_dst, D]
+
         # sendHits, as ONE collective: per-source grids are disjoint by
         # host construction, so the sum IS the merge (bool fields ride
-        # as int32 — psum is an add reduction).
-        merged = DeltaGrid(*[
-            jax.lax.psum(
-                a.astype(jnp.int32) if a.dtype == jnp.bool_ else a,
-                SHARD_AXIS,
-            )
-            for a in d
-        ])
+        # as int32 — psum is an add reduction).  int64 lanes reduce in
+        # uint64: the fingerprint lane spans the full int64 range, and
+        # if the disjointness invariant is ever violated its sum must
+        # wrap modularly (a bogus key that matches nothing) rather than
+        # hit signed overflow — two's-complement addition is
+        # bit-identical either way, so behavior under the invariant is
+        # unchanged (still pinned against the a2a step).
+        def _psum_lane(a):
+            if a.dtype == jnp.bool_:
+                a = a.astype(jnp.int32)
+            if a.dtype == jnp.int64:
+                return jax.lax.psum(
+                    a.astype(jnp.uint64), SHARD_AXIS
+                ).astype(jnp.int64)
+            return jax.lax.psum(a, SHARD_AXIS)
+
+        merged = DeltaGrid(*[_psum_lane(a) for a in d])
         me = jax.lax.axis_index(SHARD_AXIS)
         mine = DeltaGrid(*[a[me] for a in merged])  # this shard's [D] row
         key = mine.key_hash

@@ -1,0 +1,46 @@
+"""Seeded unit-suffix violations — never imported, only scanned by
+tests/test_gubguard.py.  Each `VIOLATION` line must be caught; the
+`waived` function must not be."""
+import time
+
+
+def viol_assign_wrong_suffix():
+    now_ms = time.time()  # VIOLATION: seconds stored in a _ms name
+    return now_ms
+
+
+def viol_attr_assign(obj):
+    obj.start_ns = time.monotonic()  # VIOLATION: s into _ns attribute
+    return obj
+
+
+def viol_compare(deadline_ms: int) -> bool:
+    # VIOLATION: ns compared against ms
+    return time.monotonic_ns() > deadline_ms
+
+
+def viol_subtract(start_ns: int, now_ms: int) -> int:
+    return now_ms - start_ns  # VIOLATION: ms minus ns
+
+
+def viol_return_unit_ms(t0_s: float) -> float:
+    # VIOLATION: _ms-suffixed function returns seconds
+    return time.monotonic() - t0_s
+
+
+def viol_augassign(budget_ms: float) -> float:
+    budget_ms += time.monotonic()  # VIOLATION: adds seconds to ms
+    return budget_ms
+
+
+def ok_conversions(t0_s: float) -> int:
+    elapsed_ms = (time.monotonic() - t0_s) * 1000.0  # scaled: fine
+    now_ns = time.time_ns()  # matching suffix: fine
+    t0 = time.monotonic()  # unsuffixed scratch name: fine
+    del t0
+    return int(elapsed_ms) + (now_ns // 1_000_000)
+
+
+def waived():
+    slop_ms = time.monotonic()  # gubguard: ok=unit-suffix
+    return slop_ms

@@ -82,6 +82,39 @@ def test_envparity_catches_fixture():
     assert any("GUBER_CACHE_SIZE" in f.message for f in warns), fs
 
 
+def test_unitsuffix_catches_fixture():
+    fs = run([str(FIXTURES / "viol_unitsuffix.py")],
+             select=["unit-suffix"], root=REPO)
+    lines = _lines(fs, "unit-suffix")
+    assert lines == [8, 13, 19, 23, 28, 32], fs
+    msgs = " | ".join(f.message for f in fs)
+    assert "claims ms but is assigned a value in s" in msgs
+    assert "comparison mixes ns and ms" in msgs
+    assert "function suffixed ms returns a value in s" in msgs
+    # The `# gubguard: ok=unit-suffix` pragma line stays silent, and the
+    # scaled conversions in ok_conversions are unit-correct.
+    assert all(ln < 36 for ln in lines)
+
+
+def test_unitsuffix_understands_rescaling():
+    import ast as _ast
+
+    from tools.gubguard.unitsuffix import infer_unit
+
+    cases = {
+        "time.time() * 1000": "ms",
+        "time.time_ns() // 1_000_000": "ms",
+        "int(time.monotonic() * 1e9)": "ns",
+        "(time.monotonic() - t0_s) * 1e3": "ms",
+        "max(0.0, deadline_s - time.monotonic())": "s",
+        "a_ms if fast else b_ms": "ms",
+        "some_opaque_call()": None,
+    }
+    for src, want in cases.items():
+        got = infer_unit(_ast.parse(src, mode="eval").body)
+        assert got == want, f"{src}: {got} != {want}"
+
+
 # -- the real tree is clean ----------------------------------------------
 def test_tree_is_clean():
     fs = run([str(REPO / "gubernator_tpu")], root=REPO)

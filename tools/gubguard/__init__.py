@@ -2,8 +2,8 @@
 
 Enforces the fast-lane invariants (docs/invariants.md) that the code
 otherwise carries only as convention: host-fetch containment, a
-non-blocking event loop, one global lock order, jit purity, and GUBER_*
-env parity.  Run as:
+non-blocking event loop, one global lock order, jit purity, GUBER_*
+env parity, and time-unit suffix discipline.  Run as:
 
     python -m tools.gubguard gubernator_tpu/
 
@@ -23,6 +23,7 @@ from tools.gubguard.hostsync import HostSyncChecker
 from tools.gubguard.jitpurity import JitPurityChecker
 from tools.gubguard.lockcomplete import LockCompleteChecker
 from tools.gubguard.lockorder import LockOrderChecker
+from tools.gubguard.unitsuffix import UnitSuffixChecker
 
 ALL_CHECKERS = (
     "host-sync",
@@ -31,6 +32,7 @@ ALL_CHECKERS = (
     "lock-complete",
     "jit-purity",
     "env-parity",
+    "unit-suffix",
 )
 
 
@@ -42,6 +44,7 @@ def make_checkers(select: Optional[Sequence[str]] = None) -> List[Checker]:
         "lock-complete": LockCompleteChecker,
         "jit-purity": JitPurityChecker,
         "env-parity": EnvParityChecker,
+        "unit-suffix": UnitSuffixChecker,
     }
     names = list(select) if select else list(ALL_CHECKERS)
     unknown = [n for n in names if n not in factory]

@@ -8,6 +8,7 @@ convention (docs/invariants.md):
   lock-order      one global lock acquisition order
   jit-purity      no wall-clock reads / tracer leaks in jitted code
   env-parity      GUBER_* env surface matches docs + the reference set
+  unit-suffix     _ns/_ms/_s time-name suffixes tell the truth
 
 A finding is suppressed by a pragma comment on the flagged line or the
 line directly above it:

@@ -28,7 +28,7 @@ it implements; an attached OTel SDK reads more).  Docs may therefore
 reference OTEL_ vars this repo never parses — only the
 parsed-but-undocumented warning applies to them (an OTEL_ var our code
 DOES read must still appear in deploy/example.conf).  The GUBER_*/
-GUBTRACE_*/GUBPROOF_* rules stay strict and unchanged.
+GUBTRACE_*/GUBPROOF_*/GUBRANGE_* rules stay strict and unchanged.
 """
 from __future__ import annotations
 
@@ -39,7 +39,9 @@ from typing import Dict, Iterable, List, Set
 
 from tools.gubguard.core import Checker, Finding, ModuleInfo
 
-_VAR_RE = re.compile(r"\b(?:GUBER|GUBTRACE|GUBPROOF)_[A-Z0-9_]+\b")
+_VAR_RE = re.compile(
+    r"\b(?:GUBER|GUBTRACE|GUBPROOF|GUBRANGE)_[A-Z0-9_]+\b"
+)
 # The acknowledged external namespace: standard OpenTelemetry env vars
 # (see module docstring).  Tracked separately so example.conf coverage
 # of the vars we parse is still checked, but a documented-only OTEL_

@@ -1,0 +1,255 @@
+"""unit-suffix: host-side time values must name their unit truthfully.
+
+The device planes get dimensional checking from gubrange's jaxpr taint
+(tools/gubrange/units.py); host code gets this AST pass.  The repo's
+convention is that a time-valued name carries its unit as a suffix —
+``_ns`` / ``_us`` / ``_ms`` / ``_s`` — and the checker enforces that the
+suffix, when present, is TRUE:
+
+  * an assignment to a suffixed name (or attribute) whose right-hand
+    side provably carries a different unit is an error
+    (``now_ms = time.time()`` stores seconds in a millisecond name);
+  * adding, subtracting or comparing two operands with different
+    provable units is an error (``deadline_ms - start_ns``);
+  * a ``return`` inside a function whose own name is suffixed must not
+    provably return a different unit (``def elapsed_ms(): return
+    time.monotonic() - t0``).
+
+Unsuffixed scratch names (``t0``, ``start``, ``deadline``) stay legal —
+the discipline is "if you name the unit, name it right", which is what
+keeps the pass adoptable without a tree-wide rename.  Units are
+inferred only where provable: the stdlib wall-clock sources
+(``time.time``/``monotonic``/``perf_counter`` → s, their ``_ns``
+variants → ns), calls whose terminal name is itself suffixed
+(``_now_ms()`` → ms), the repo clock seam (``millisecond_now`` → ms,
+``now_ns`` → ns), and decimal rescaling by 1e3/1e6/1e9 which shifts the
+unit (``time.time() * 1000`` → ms).  Anything else is unit-unknown and
+never flagged.  Waive with ``# gubguard: ok=unit-suffix``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.gubguard.core import Checker, Finding, ModuleInfo, dotted_name
+
+# Finest-to-coarsest; rescaling moves along this ladder.
+_LADDER = ("s", "ms", "us", "ns")
+
+# Exact dotted wall-clock sources (the stdlib time module).
+_CALL_UNITS = {
+    "time.time": "s",
+    "time.monotonic": "s",
+    "time.perf_counter": "s",
+    "time.time_ns": "ns",
+    "time.monotonic_ns": "ns",
+    "time.perf_counter_ns": "ns",
+    "time.clock_gettime_ns": "ns",
+}
+
+# The repo's clock seam (core/clock.py): unit-bearing names without a
+# literal suffix.
+_TERMINAL_UNITS = {
+    "millisecond_now": "ms",
+    "time_ns": "ns",
+    "monotonic_ns": "ns",
+    "perf_counter_ns": "ns",
+}
+
+# Numeric factors that shift the ladder by whole steps.
+_SCALES = {
+    1000: 1, 1000.0: 1, 1e3: 1,
+    1000000: 2, 1000000.0: 2, 1e6: 2,
+    1000000000: 3, 1000000000.0: 3, 1e9: 3,
+}
+
+# Wrappers transparent to units.
+_TRANSPARENT_CALLS = {"int", "float", "abs", "round"}
+
+
+def name_unit(ident: str) -> Optional[str]:
+    """The unit a bare identifier claims via its suffix, if any."""
+    for suf, unit in (("_ns", "ns"), ("_us", "us"), ("_ms", "ms"),
+                      ("_s", "s")):
+        if ident.endswith(suf):
+            return unit
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _shift(unit: Optional[str], steps: int) -> Optional[str]:
+    if unit is None:
+        return None
+    i = _LADDER.index(unit) + steps
+    return _LADDER[i] if 0 <= i < len(_LADDER) else None
+
+
+def _const_scale(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ):
+        return _SCALES.get(node.value)
+    return None
+
+
+def infer_unit(node: ast.AST) -> Optional[str]:
+    """Best-effort provable unit of an expression; None = unknown."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        term = _terminal(node)
+        return name_unit(term) if term else None
+    if isinstance(node, ast.Call):
+        dotted = dotted_name(node.func)
+        if dotted in _CALL_UNITS:
+            return _CALL_UNITS[dotted]
+        term = _terminal(node.func)
+        if term in _TERMINAL_UNITS:
+            return _TERMINAL_UNITS[term]
+        if term in _TRANSPARENT_CALLS and len(node.args) == 1:
+            return infer_unit(node.args[0])
+        if term in ("max", "min"):
+            units = {infer_unit(a) for a in node.args} - {None}
+            return units.pop() if len(units) == 1 else None
+        return name_unit(term) if term else None
+    if isinstance(node, ast.UnaryOp):
+        return infer_unit(node.operand)
+    if isinstance(node, ast.BinOp):
+        left, right = infer_unit(node.left), infer_unit(node.right)
+        if isinstance(node.op, (ast.Mult, ast.Div, ast.FloorDiv)):
+            down = isinstance(node.op, ast.Mult)
+            scale = _const_scale(node.right)
+            if scale is not None and left is not None:
+                return _shift(left, scale if down else -scale)
+            if isinstance(node.op, ast.Mult):
+                scale = _const_scale(node.left)
+                if scale is not None and right is not None:
+                    return _shift(right, scale)
+            return None
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            if left is not None and right is not None:
+                return left if left == right else None
+            return left if left is not None else right
+        if isinstance(node.op, ast.Mod):
+            return infer_unit(node.left)
+    if isinstance(node, ast.IfExp):
+        body, orelse = infer_unit(node.body), infer_unit(node.orelse)
+        if body is not None and orelse is not None:
+            return body if body == orelse else None
+        return body if body is not None else orelse
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo, checker_name: str) -> None:
+        self.mod = mod
+        self.checker = checker_name
+        self.findings: List[Finding] = []
+        self._fn_units: List[Optional[str]] = []
+
+    def _err(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            checker=self.checker, path=self.mod.relpath,
+            line=getattr(node, "lineno", 1), message=message,
+        ))
+
+    # -- rule 1: suffixed targets must receive their own unit ------------
+
+    def _check_store(self, target: ast.AST, value: ast.AST) -> None:
+        term = _terminal(target)
+        if term is None:
+            return
+        claimed = name_unit(term)
+        if claimed is None:
+            return
+        actual = infer_unit(value)
+        if actual is not None and actual != claimed:
+            self._err(target, (
+                f"'{term}' claims {claimed} but is assigned a value "
+                f"in {actual} — rename the target or convert the value"
+            ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple) and isinstance(
+                node.value, ast.Tuple
+            ) and len(tgt.elts) == len(node.value.elts):
+                for t, v in zip(tgt.elts, node.value.elts):
+                    self._check_store(t, v)
+            else:
+                self._check_store(tgt, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_store(node.target, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            self._check_store(node.target, node.value)
+        self.generic_visit(node)
+
+    # -- rule 2: no cross-unit add/sub/compare ---------------------------
+
+    def _check_mix(self, node: ast.AST, a: ast.AST, b: ast.AST,
+                   what: str) -> None:
+        ua, ub = infer_unit(a), infer_unit(b)
+        if ua is not None and ub is not None and ua != ub:
+            self._err(node, (
+                f"{what} mixes {ua} and {ub} operands — convert one "
+                "side explicitly"
+            ))
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            self._check_mix(node, node.left, node.right,
+                            "addition" if isinstance(node.op, ast.Add)
+                            else "subtraction")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        operands = [node.left, *node.comparators]
+        for a, b in zip(operands, operands[1:]):
+            self._check_mix(node, a, b, "comparison")
+        self.generic_visit(node)
+
+    # -- rule 3: suffixed functions must return their own unit -----------
+
+    def _visit_fn(self, node) -> None:
+        self._fn_units.append(name_unit(node.name))
+        self.generic_visit(node)
+        self._fn_units.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._fn_units.append(None)
+        self.generic_visit(node)
+        self._fn_units.pop()
+
+    def visit_Return(self, node: ast.Return) -> None:
+        claimed = self._fn_units[-1] if self._fn_units else None
+        if claimed is not None and node.value is not None:
+            actual = infer_unit(node.value)
+            if actual is not None and actual != claimed:
+                self._err(node, (
+                    f"function suffixed {claimed} returns a value in "
+                    f"{actual} — convert before returning"
+                ))
+        self.generic_visit(node)
+
+
+class UnitSuffixChecker(Checker):
+    name = "unit-suffix"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        v = _Visitor(mod, self.name)
+        v.visit(mod.tree)
+        return v.findings

@@ -1,0 +1,239 @@
+"""gubrange: interval abstract interpretation + time-unit taint.
+
+The fourth static plane beside gubguard (source promises), gubtrace
+(what XLA compiles), and gubproof (protocol algebra):
+
+  ranges   walk every gubtrace-registered kernel's jaxpr with an exact
+           interval domain seeded from its operational envelope
+           (tools/gubrange/envelopes/<kernel>.json) and a dimensional
+           unit tag, proving no signed intermediate can leave its dtype
+           range, no division sees a zero-inclusive divisor, no
+           negative interval feeds timestamp math, and no ns/ms/s/epoch
+           confusion survives — then, for any violation, executing the
+           real kernel at the interval corner so the report carries a
+           concrete wrapped output (tools/gubrange/witness.py)
+  suffix   the host-side AST pass: `_ns`/`_ms`/`_s` suffix discipline
+           on wall-clock-derived names (delegates to the gubguard
+           unit-suffix checker so pragmas and fixtures are shared)
+
+Exactness cuts both ways: declared envelopes must match what the
+analysis proves (expect_peak equality, budgets spent exactly), so the
+registry can never rot into theater.  Run as:
+
+    python -m tools.gubrange --strict
+
+Exit 0 = clean, 1 = findings, 2 = usage error.  Like gubtrace, the
+whole plane runs under JAX_PLATFORMS=cpu — no accelerator needed.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from tools.gubrange.absint import RangeWalk
+from tools.gubrange.envelope import (
+    BUDGETABLE,
+    ENVELOPE_DIR,
+    Envelope,
+    load_envelopes,
+    save_peak,
+    seed,
+)
+from tools.gubrange.witness import run_witness
+from tools.gubtrace.core import Finding, KernelSpec
+
+ALL_PHASES = ("ranges", "suffix")
+
+# The registry's canonical mesh width (tools/gubtrace/registry.py
+# N_SHARDS): psum-style collectives scale interval bounds by this.
+COLLECTIVE_N = 8
+
+
+def _analyze_kernel(
+    spec: KernelSpec,
+    env: Envelope,
+    update: bool,
+    dump_dir: Optional[Path],
+) -> List[Finding]:
+    import jax
+
+    findings: List[Finding] = []
+
+    def err(checker: str, msg: str, where: str = "",
+            severity: str = "error") -> None:
+        findings.append(Finding(
+            checker=checker, kernel=spec.name, message=msg,
+            severity=severity, where=where,
+        ))
+
+    for msg in env.validate():
+        err("envelope", msg)
+
+    try:
+        built = spec.build()
+    except Exception as e:
+        err("trace", f"failed to build: {type(e).__name__}: {e}")
+        return findings
+
+    sig_name, make_args = next(iter(built.signatures.items()))
+    args = make_args()
+    seeds, _unmatched, unused = seed(env, args)
+    for pat in unused:
+        err("envelope",
+            f"input pattern '{pat}' matches no leaf of signature "
+            f"{sig_name} — stale declaration")
+
+    try:
+        closed = jax.make_jaxpr(built.trace_fn)(*args)
+    except Exception as e:
+        err("trace", f"failed to trace: {type(e).__name__}: {e}")
+        return findings
+
+    walk = RangeWalk(collective_n=COLLECTIVE_N)
+    walk.walk(closed, seeds)
+
+    by_cls: Dict[str, list] = {}
+    for issue in walk.issues:
+        by_cls.setdefault(issue.cls, []).append(issue)
+
+    overflowed = False
+    for issue in by_cls.pop("overflow", ()):
+        overflowed = True
+        err("overflow", issue.message, where=issue.where)
+    for issue in by_cls.pop("unknown-primitive", ()):
+        err("absint", issue.message, where=issue.where,
+            severity="warning")
+    for cls in BUDGETABLE:
+        issues = by_cls.pop(cls, [])
+        budget = env.budgets.get(cls, 0)
+        if len(issues) > budget:
+            for issue in issues:
+                err(cls,
+                    f"{issue.message} [observed {len(issues)} > "
+                    f"budgeted {budget}]", where=issue.where)
+        elif len(issues) < budget:
+            err(cls,
+                f"budget declares {budget} but the analysis finds only "
+                f"{len(issues)} — shrink the declaration",
+                severity="warning")
+    for cls, issues in by_cls.items():  # never happens by construction
+        for issue in issues:
+            err(cls, issue.message, where=issue.where)
+
+    if update and env.path is not None:
+        if env.expect_peak != walk.peak:
+            save_peak(env, walk.peak)
+    elif env.expect_peak is None:
+        err("peak",
+            f"envelope declares no expect_peak; analysis proves "
+            f"{walk.peak} (run with --update to record it)")
+    elif env.expect_peak != walk.peak:
+        direction = (
+            "looser than provable — tighten it"
+            if env.expect_peak > walk.peak
+            else "below what is reachable"
+        )
+        err("peak",
+            f"expect_peak {env.expect_peak} != proved peak "
+            f"{walk.peak} ({direction})")
+
+    if overflowed:
+        report = run_witness(built, env, sig_name)
+        if report:
+            err("witness", report)
+
+    if dump_dir is not None and any(
+        f.severity == "error" for f in findings
+    ):
+        dump_dir.mkdir(parents=True, exist_ok=True)
+        (dump_dir / f"{spec.name}.json").write_text(json.dumps({
+            "kernel": spec.name,
+            "signature": sig_name,
+            "peak": str(walk.peak),
+            "issues": [i.__dict__ for i in walk.issues],
+            "findings": [f.__dict__ for f in findings],
+        }, indent=2) + "\n", encoding="utf-8")
+    return findings
+
+
+def run(
+    select: Optional[Sequence[str]] = None,
+    kernel: Optional[str] = None,
+    root: Optional[Path] = None,
+    update: bool = False,
+    envelope_dir: Optional[Path] = None,
+    specs: Optional[Sequence[KernelSpec]] = None,
+    dump_dir: Optional[Path] = None,
+) -> List[Finding]:
+    """Run the selected phases; returns sorted findings."""
+    root = root or Path.cwd()
+    phases = list(select) if select else list(ALL_PHASES)
+    unknown = [p for p in phases if p not in ALL_PHASES]
+    if unknown:
+        raise ValueError(
+            f"unknown phases: {unknown} (have: {', '.join(ALL_PHASES)})"
+        )
+
+    findings: List[Finding] = []
+
+    if "ranges" in phases:
+        import jax
+
+        # The kernels' own package does this on import; the fixture
+        # specs (and any future out-of-tree spec list) must see the
+        # same 64-bit world or every int64 bound silently halves.
+        jax.config.update("jax_enable_x64", True)
+        if specs is None:
+            from tools.gubtrace.registry import specs as registry_specs
+
+            specs = registry_specs()
+        envelopes = load_envelopes(envelope_dir or ENVELOPE_DIR)
+        if kernel is not None:
+            wanted = {k.strip() for k in kernel.split(",") if k.strip()}
+            missing = wanted - {s.name for s in specs}
+            if missing:
+                raise ValueError(
+                    f"unknown kernels: {sorted(missing)}"
+                )
+            specs = [s for s in specs if s.name in wanted]
+        analyzed = set()
+        for spec in specs:
+            analyzed.add(spec.name)
+            env = envelopes.get(spec.name)
+            if env is None:
+                findings.append(Finding(
+                    checker="envelope", kernel=spec.name,
+                    message=(
+                        "no operational envelope — add "
+                        f"tools/gubrange/envelopes/{spec.name}.json"
+                    ),
+                ))
+                continue
+            findings.extend(
+                _analyze_kernel(spec, env, update, dump_dir)
+            )
+        if kernel is None:
+            for name in sorted(set(envelopes) - analyzed):
+                findings.append(Finding(
+                    checker="envelope", kernel=name,
+                    message=(
+                        "envelope has no registered kernel — stale "
+                        f"file {envelopes[name].path}"
+                    ),
+                ))
+
+    if "suffix" in phases:
+        from tools.gubguard import run as gubguard_run
+
+        for f in gubguard_run(
+            [str(root / "gubernator_tpu")],
+            select=["unit-suffix"], root=root,
+        ):
+            findings.append(Finding(
+                checker=f.checker, kernel="-", message=f.message,
+                severity=f.severity, where=f"{f.path}:{f.line}",
+            ))
+
+    findings.sort(key=lambda f: (f.kernel, f.checker, f.message))
+    return findings

@@ -1,0 +1,127 @@
+"""CLI: python -m tools.gubrange [--select ranges,suffix] [--kernel N].
+
+Must configure the platform BEFORE jax initializes: the analyzer runs
+device-free (JAX_PLATFORMS=cpu) on a virtual 8-device host platform so
+the mesh kernels trace exactly as CI's virtual pod slice does.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pin_cpu_platform() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def main(argv=None) -> int:
+    _pin_cpu_platform()
+    from pathlib import Path
+
+    from tools.gubrange import ALL_PHASES, run
+
+    ap = argparse.ArgumentParser(
+        prog="gubrange",
+        description=(
+            "Interval abstract interpretation + time-unit taint over "
+            "every registered kernel (see docs/gubrange.md)."
+        ),
+    )
+    ap.add_argument(
+        "--select", metavar="PHASES",
+        help="comma-separated phase subset of: " + ", ".join(ALL_PHASES),
+    )
+    ap.add_argument(
+        "--kernel", action="append", metavar="NAME",
+        help="restrict the ranges phase to this kernel (repeatable)",
+    )
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite each envelope's expect_peak to the proved peak",
+    )
+    ap.add_argument(
+        "--list", action="store_true", dest="list_kernels",
+        help="list registered kernels and their envelopes, then exit",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="treat warnings as errors (also honors GUBRANGE_STRICT)",
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="repo root (default: cwd)",
+    )
+    ap.add_argument(
+        "--dump-dir", default=None,
+        help=(
+            "where to write failing kernels' analysis dumps "
+            "(default: $GUBRANGE_DUMP_DIR or gubrange-dumps)"
+        ),
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_kernels:
+        from tools.gubrange.envelope import load_envelopes
+        from tools.gubtrace.registry import specs
+
+        envelopes = load_envelopes()
+        for s in specs():
+            env = envelopes.get(s.name)
+            tag = env.path.name if env and env.path else "MISSING"
+            print(f"{s.name}  ({s.where})  envelope={tag}")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    from gubernator_tpu.core.config import (
+        gubrange_dump_dir_from_env,
+        gubrange_strict_from_env,
+    )
+
+    strict = args.strict or gubrange_strict_from_env()
+    dump_dir = Path(args.dump_dir or gubrange_dump_dir_from_env())
+    try:
+        findings = run(
+            select=select,
+            kernel=",".join(args.kernel) if args.kernel else None,
+            root=Path(args.root),
+            update=args.update,
+            dump_dir=dump_dir,
+        )
+    except ValueError as e:
+        print(f"gubrange: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    errors = [
+        f for f in findings
+        if f.severity == "error" or (strict and f.severity == "warning")
+    ]
+    warnings = [f for f in findings if f.severity == "warning"]
+    if not args.as_json:
+        print(
+            f"gubrange: {len(errors)} error(s), "
+            f"{len(warnings)} warning(s)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

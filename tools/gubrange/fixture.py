@@ -1,0 +1,56 @@
+"""Negative-control fixture: a kernel whose int64 algebra DOES wrap.
+
+`fixture_mul_unclamped` charges `hits × cost` tokens with no clamp —
+the exact bug class gubrange exists to rule out.  Its envelope
+(tests/gubrange_fixtures/envelopes/fixture_mul_unclamped.json) declares
+hits, cost ≤ 4e9, so the product reaches 1.6e19 > 2^63−1 and the
+analysis must report an overflow; the corner witness then executes the
+real kernel and the output is demonstrably negative.  The smoke script
+and tests/test_gubrange.py assert BOTH, keeping the plane honest: if
+the walker ever goes blind to real wraps, the control stops failing
+and CI fails instead.
+"""
+from __future__ import annotations
+
+from tools.gubtrace.core import BuiltKernel, KernelSpec
+
+FIXTURE_B = 64
+
+
+def _build() -> BuiltKernel:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def fixture_mul_unclamped_impl(hits, cost, remaining):
+        charge = hits * cost  # the bug: no saturation, can wrap
+        return charge, remaining - charge
+
+    jitted = jax.jit(fixture_mul_unclamped_impl)
+
+    def sig():
+        return (
+            np.zeros(FIXTURE_B, np.int64),
+            np.zeros(FIXTURE_B, np.int64),
+            np.full(FIXTURE_B, 10**9, np.int64),
+        )
+
+    del jnp
+    return BuiltKernel(
+        fn=jitted,
+        trace_fn=fixture_mul_unclamped_impl,
+        signatures={"B64": sig},
+        counters=(),
+        expect_aliased=0,
+    )
+
+
+def fixture_specs():
+    return [
+        KernelSpec(
+            name="fixture_mul_unclamped",
+            where="tools/gubrange/fixture.py",
+            build=_build,
+            invariants=frozenset(),
+        )
+    ]

@@ -1,0 +1,145 @@
+"""The time-unit dimension algebra for the gubrange jaxpr taint.
+
+Unit tags are seeded from envelope metadata (tools/gubrange/envelopes)
+and propagated through the same walk that carries intervals.  The
+lattice is *gradual*: `None` means "no declared dimension" and is
+polymorphic (literals, enums, flags, hashes) — a rule only fires when
+BOTH operands carry a unit and the combination is dimensionally wrong.
+That keeps the checker sharp on real confusions (ns+ms, epoch+epoch,
+hits×duration) without drowning every unitless lane select in noise.
+
+Tags:
+  count, bytes            cardinalities
+  ns, us, ms, s           durations at a granularity
+  epoch_ns, epoch_ms, …   absolute timestamps at a granularity
+  rate_ns, rate_ms, …     duration-per-count (leaky-bucket drip rate)
+
+Rules (X is a duration granularity):
+  X + X = X         epoch_X + X = epoch_X    epoch + epoch   ERROR
+  epoch_X - epoch_X = X                      X - epoch       ERROR
+  X × count = X     count × rate_X = X       X × Y           ERROR
+  X / count = rate_X     X / rate_X = count  epoch / _       ERROR
+  ns + ms (granularity mix in add/sub/compare/join)          ERROR
+
+Each function returns (result_unit, error_reason_or_None).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+DURATIONS = ("ns", "us", "ms", "s")
+EPOCHS = tuple("epoch_" + d for d in DURATIONS)
+RATES = tuple("rate_" + d for d in DURATIONS)
+COUNTS = ("count", "bytes")
+ALL_UNITS = DURATIONS + EPOCHS + RATES + COUNTS
+
+U = Optional[str]
+Res = Tuple[U, Optional[str]]
+
+
+def is_epoch(u: U) -> bool:
+    return u is not None and u.startswith("epoch_")
+
+
+def is_duration(u: U) -> bool:
+    return u in DURATIONS
+
+
+def is_rate(u: U) -> bool:
+    return u is not None and u.startswith("rate_")
+
+
+def epoch_of(d: str) -> str:
+    return "epoch_" + d
+
+
+def duration_of(u: str) -> str:
+    """The duration granularity inside an epoch_/rate_ tag."""
+    return u.split("_", 1)[1]
+
+
+def add(a: U, b: U) -> Res:
+    if a is None:
+        return (b, None)
+    if b is None:
+        return (a, None)
+    if a == b:
+        if is_epoch(a):
+            return (a, f"{a} + {b}: adding two absolute timestamps")
+        return (a, None)
+    if is_epoch(a) and b == duration_of(a):
+        return (a, None)
+    if is_epoch(b) and a == duration_of(b):
+        return (b, None)
+    return (None, f"{a} + {b}")
+
+
+def sub(a: U, b: U) -> Res:
+    if b is None:
+        return (a, None)
+    if a is None:
+        return (None, None)
+    if a == b:
+        if is_epoch(a):
+            return (duration_of(a), None)
+        return (a, None)
+    if is_epoch(a) and b == duration_of(a):
+        return (a, None)
+    if is_epoch(b):
+        return (None, f"{a} - {b}: subtracting an absolute timestamp "
+                      "from a non-timestamp")
+    return (None, f"{a} - {b}")
+
+
+def mul(a: U, b: U) -> Res:
+    if a is None:
+        return (b, None)
+    if b is None:
+        return (a, None)
+    if is_epoch(a) or is_epoch(b):
+        return (None, f"{a} × {b}: scaling an absolute timestamp")
+    for x, y in ((a, b), (b, a)):
+        if x in COUNTS:
+            if y in COUNTS:
+                return ("count", None)
+            if is_rate(y):
+                return (duration_of(y), None)
+            return (y, None)  # count × duration = duration
+    return (None, f"{a} × {b}")
+
+
+def div(a: U, b: U) -> Res:
+    if b is None:
+        return (a, None)
+    if a is None:
+        return (None, None)
+    if is_epoch(a):
+        return (None, f"{a} / {b}: dividing an absolute timestamp")
+    if a == b:
+        return ("count", None)  # ratio of like quantities
+    if b in COUNTS:
+        if is_duration(a):
+            return ("rate_" + a, None)
+        return (None, None)
+    if is_rate(b) and a == duration_of(b):
+        return ("count", None)
+    if is_epoch(b):
+        return (None, f"{a} / {b}: dividing by an absolute timestamp")
+    return (None, f"{a} / {b}")
+
+
+def join(a: U, b: U) -> Res:
+    """select / min / max / clamp / scatter-merge: units must agree."""
+    if a is None:
+        return (b, None)
+    if b is None:
+        return (a, None)
+    if a == b:
+        return (a, None)
+    return (None, f"{a} vs {b}: joining mixed units")
+
+
+def compare(a: U, b: U) -> Optional[str]:
+    if a is None or b is None or a == b:
+        return None
+    return f"{a} vs {b}: comparing mixed units"

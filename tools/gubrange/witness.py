@@ -1,0 +1,55 @@
+"""Concrete witnesses: execute the real kernel at the envelope corner.
+
+When the interval analysis reports that an intermediate can leave its
+dtype range, the failure report should not be an abstract claim — this
+module synthesizes the minimal concrete input at the violated bound's
+interval corner (every envelope-matched integer leaf at its declared
+max), executes the REAL kernel eagerly on CPU, and reports the output
+extremes so the wrap is visible in black and white.  The shipped
+negative-control fixture (tools/gubrange/fixture.py) keeps this honest:
+its witness demonstrably wraps negative from all-nonnegative inputs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from tools.gubrange.envelope import Envelope, corner_args
+
+
+def run_witness(
+    built, env: Envelope, sig_name: str, corner: str = "max"
+) -> Optional[str]:
+    """Execute trace_fn at the envelope corner; returns a one-line
+    report of the output extremes (None if execution itself fails)."""
+    import jax
+    import numpy as np
+
+    make_args = built.signatures[sig_name]
+    try:
+        args = corner_args(env, make_args(), corner=corner)
+        with jax.disable_jit():
+            out = built.trace_fn(*args)
+    except Exception as e:
+        return f"witness execution failed: {type(e).__name__}: {e}"
+
+    flat, _ = jax.tree_util.tree_flatten_with_path((out,))
+    parts = []
+    wrapped = False
+    seeded_nonneg = all(r.min >= 0 for r in env.inputs)
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if arr.size == 0 or arr.dtype.kind not in "iu":
+            continue
+        lo, hi = int(arr.min()), int(arr.max())
+        key = jax.tree_util.keystr(path)
+        parts.append(f"{key}∈[{lo}, {hi}]")
+        if seeded_nonneg and lo < 0:
+            wrapped = True
+    head = (
+        "WRAPPED (negative output from all-nonnegative inputs): "
+        if wrapped else ""
+    )
+    return (
+        f"{head}executed at envelope {corner}-corner "
+        f"(sig {sig_name}): " + "; ".join(parts)
+    )

@@ -178,16 +178,19 @@ def _step_spec(
 # -- deliberate-cast budgets (ops/step.py) -------------------------------
 # apply_batch taints every int64 table/batch counter.  The licensed
 # casts are the leaky bucket's Go-float arithmetic — algorithms.go
-# computes burst/rate/leak/hits in float64, re-derived here as the 11
-# `_f64(...)` sites in apply_batch_impl (lb0, lb1, l_rate x3, elapsed,
-# lb4, ln_rate x2, ln_rem_f; each is exact below 2^53, the float64
-# mantissa).  The 12th would be a regression.
-_APPLY_CASTS = {"to_f64": 11}
+# computes burst/rate/leak/hits in float64, re-derived here as the 14
+# tainted `_f64(...)` sites in apply_batch_impl (lb0, lb1, l_rate x3,
+# elapsed, lb4, ln_rate x2, ln_rem_f, plus the saturating ResetTime
+# rewrite's f_now, f_lim and _f64(ln_resp_rem) — the reset product now
+# runs in float64 through the _trunc_i64 saturation contract; each is
+# exact below 2^53, the float64 mantissa).  The 15th would be a
+# regression.
+_APPLY_CASTS = {"to_f64": 14}
 _APPLY_COUNTERS = _TABLE_COUNTERS + _BATCH_COUNTERS + (".limit",
                                                        ".duration", "[2]")
 # Packed q-form: one widened-int64 row is narrowed back to the int32
 # algo enum (values 0/1 by wire contract).
-_APPLY_Q_CASTS = {"to_f64": 11, "to_i32": 1}
+_APPLY_Q_CASTS = {"to_f64": 14, "to_i32": 1}
 
 
 def _migrate_spec(name: str, fn_name: str, impl_name: str,
@@ -270,7 +273,7 @@ def _mega_ring_spec() -> KernelSpec:
     scan (docs/ring.md) — up to GUBER_RING_ROUNDS x GUBER_RING_SLOTS
     stacked rounds per dispatch.  The outer scan threads (table, seq)
     through ring_step_impl, so the taint and cast contract is exactly
-    ring_step's (11 to_f64 leaky float sites + 1 to_i32 algo narrowing
+    ring_step's (14 to_f64 leaky float sites + 1 to_i32 algo narrowing
     propagated through the nested scan carries); donation is table-only
     — the seq word's keep rule is inherited from the base ring."""
 
@@ -365,7 +368,7 @@ def _ring_spec() -> KernelSpec:
     """ops/ring.py ring_step: the ring discipline's bounded multi-round
     scan (docs/ring.md).  The scan body is apply_batch_packed_q traced
     once, so the int64 counter taint propagates through the lax.scan
-    carry and the licensed casts are exactly the q-form step's (11
+    carry and the licensed casts are exactly the q-form step's (14
     to_f64 leaky float sites + 1 to_i32 algo narrowing); the sequence
     word is tainted int64 arithmetic with no cast.  Only the table is
     donated — the seq word's output buffer must survive the next
@@ -592,11 +595,11 @@ def _global_sync_spec(psum: bool = False) -> KernelSpec:
             ),
             # Two apply_batch passes ride inside the sync step; the
             # broadcast re-read runs with hits=0 (a literal, untainted)
-            # so its _f64(r_hits) does not count: 11 + 10.  The psum
+            # so its _f64(r_hits) does not count: 14 + 13.  The psum
             # form shares the budget — it swaps the aggregation
             # collective (one psum vs all_to_all + sort/segment), not
             # the apply passes.
-            allowed_casts={"to_f64": 21},
+            allowed_casts={"to_f64": 27},
             perturbations={},
             recompile_budget=1,
             expect_aliased=24,  # auth + cache tables, 12 leaves each
@@ -613,7 +616,7 @@ def _mesh_ring_spec() -> KernelSpec:
     """parallel/sharded.py make_mesh_ring_step: the ring discipline's
     bounded scan lifted to the sharded grid table (docs/ring.md).  Each
     shard runs ops/ring.ring_step_impl verbatim, so the taint and cast
-    contract is exactly ring_step's (11 to_f64 leaky float sites + 1
+    contract is exactly ring_step's (14 to_f64 leaky float sites + 1
     to_i32 algo narrowing propagated through the shard_map + scan
     carry); the per-shard sequence words are tainted int64 arithmetic
     with no cast.  Only the table is donated — the seq words' output
