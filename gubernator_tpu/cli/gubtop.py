@@ -116,6 +116,27 @@ def _node_lines(addr: str, v: Dict) -> List[str]:
                 f" DROPS={drops}" if drops else "",
             )
         )
+    region = v.get("region")
+    if region:
+        # The region carve plane (docs/multiregion.md): drift is the
+        # un-reconciled burn backlog toward every home region; any
+        # non-remote link is a WAN incident in progress.
+        links = region.get("links") or {}
+        bad = [
+            f"{rg}:{lk.get('state')}" for rg, lk in sorted(links.items())
+            if lk.get("state") != "remote"
+        ]
+        dropped = region.get("reconcile_dropped", 0)
+        lines.append(
+            "    region: %s drift=%d carves=%d rehomes=%d%s%s" % (
+                region.get("name", "?"),
+                region.get("drift", 0),
+                region.get("carve_served", 0),
+                region.get("rehomes", 0),
+                f" dropped={dropped}" if dropped else "",
+                " DEGRADED[%s]" % ",".join(bad) if bad else "",
+            )
+        )
     load = v.get("load")
     if load:
         # A gubload scenario phase is driving this node right now —

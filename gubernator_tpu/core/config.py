@@ -398,6 +398,125 @@ def reshard_config_from_env() -> ReshardConfig:
 
 
 @dataclass
+class RegionConfig:
+    """Planet-scale active-active regions (runtime/multiregion.py;
+    docs/multiregion.md; the reference ships only a stub sender,
+    multiregion.go:23-102 — this is the follow-the-sun layer it never
+    grew).
+
+    Each region runs its own mesh + peer ring.  A key's HOME region
+    (a deterministic rendezvous pick over the configured region set,
+    using the region-picker hash) owns truth; every other region
+    serves the key from a bounded `<key>.region-carve` shadow slot at
+    `fraction x limit` per window, so cluster-wide admission is
+    bounded by `limit x (1 + remote_regions x fraction)` — the
+    lease/mirror/shadow carve algebra with geography (not death,
+    pressure, or a remap) as the gate.  Burned carve hits reconcile
+    to the home owner asynchronously over the WAN peer arcs every
+    `reconcile_ms`, with the GLOBAL lane's at-most-once discipline
+    (provably-unsent failures re-queue and survive a region
+    partition; ambiguous failures drop — arXiv 1909.08969's caution
+    against retry inflation).  `drift_max` bounds the un-reconciled
+    burn backlog: past it the carve refuses new admissions, so a
+    long partition's divergence stays finite.  On region heal the
+    carve re-homes through REGION_PREPARE -> TRANSFER -> CUTOVER
+    (late burns compensated at cutover; a carve slot still homed
+    remotely keeps its consumed state, so each window's fraction is
+    spent at most once — only slots whose home MOVED are dropped)."""
+
+    enabled: bool = False
+    # This daemon's region name.  Empty + enabled defers to
+    # GUBER_DATA_CENTER at daemon assembly (the region name IS the
+    # data-center tag peers advertise on the wire).
+    name: str = ""
+    # region -> WAN seed addresses (grpc host:port).  Remote entries
+    # are dialed as cross-region peers; the key set (plus `name`)
+    # is the configured region universe the home rendezvous runs
+    # over.  Empty = derive the universe from live peer discovery.
+    peers: Dict[str, List[str]] = field(default_factory=dict)
+    # Fraction of the limit a remote region may admit from its local
+    # carve slot per window.
+    fraction: float = 0.25
+    # Burned-hit WAN reconcile cadence in milliseconds.
+    reconcile_ms: int = 500
+    # Max un-reconciled burned hits (per node, across keys) before
+    # the carve refuses new admissions — the bounded-divergence
+    # valve for a long partition.
+    drift_max: int = 100_000
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.fraction <= 1.0:
+            raise ValueError(
+                f"region fraction must be in (0, 1], got {self.fraction}"
+            )
+        if self.reconcile_ms < 1:
+            raise ValueError(
+                f"region reconcile_ms must be >= 1, "
+                f"got {self.reconcile_ms}"
+            )
+        if self.drift_max < 1:
+            raise ValueError(
+                f"region drift_max must be >= 1, got {self.drift_max}"
+            )
+        if self.peers and self.name and self.name not in self.peers:
+            raise ValueError(
+                f"self region {self.name!r} missing from the region "
+                "peer map — a daemon must appear in its own universe "
+                f"(regions: {', '.join(sorted(self.peers))})"
+            )
+
+
+def _parse_region_peers(raw: str) -> Dict[str, List[str]]:
+    """Parse GUBER_REGION_PEERS: `region=addr|addr,region2=addr`.
+    A region with no addresses (`region=`) is legal — it names the
+    region in the universe without seeding WAN dials (discovery
+    supplies the peers)."""
+    out: Dict[str, List[str]] = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"region peer entry {entry!r} is not region=addr|addr"
+            )
+        region, _, addrs = entry.partition("=")
+        region = region.strip()
+        if not region:
+            raise ValueError(
+                f"region peer entry {entry!r} has an empty region name"
+            )
+        out[region] = [
+            a.strip() for a in addrs.split("|") if a.strip()
+        ]
+    return out
+
+
+def region_config_from_env() -> RegionConfig:
+    """The region plane's env parse (same contract as
+    hotkey_config_from_env): validation errors name the env surface
+    at startup — fraction outside (0, 1] and a self region absent
+    from the peer map are rejected here, not deep in RegionManager."""
+    try:
+        return RegionConfig(
+            enabled=_env("GUBER_REGION_ENABLED", "false").lower()
+            in ("1", "true", "yes"),
+            name=_env("GUBER_REGION_NAME", "").strip(),
+            peers=_parse_region_peers(_env("GUBER_REGION_PEERS", "")),
+            fraction=float(_env("GUBER_REGION_FRACTION", "0.25")),
+            reconcile_ms=_env_int("GUBER_REGION_RECONCILE_MS", 500),
+            drift_max=_env_int("GUBER_REGION_DRIFT_MAX", 100_000),
+        )
+    except ValueError as e:
+        raise ValueError(
+            "region env config (GUBER_REGION_ENABLED, "
+            "GUBER_REGION_NAME, GUBER_REGION_PEERS, "
+            "GUBER_REGION_FRACTION, GUBER_REGION_RECONCILE_MS, "
+            f"GUBER_REGION_DRIFT_MAX): {e}"
+        ) from None
+
+
+@dataclass
 class StatsConfig:
     """Gubstat — state-plane introspection (runtime/gubstat.py;
     docs/observability.md; no reference analog — the Go daemon's cache
@@ -766,6 +885,9 @@ class Config:
     # Guberberg two-tier key table (runtime/coldtier.py;
     # docs/tiering.md).
     tier: TierConfig = field(default_factory=TierConfig)
+    # Planet-scale active-active regions (runtime/multiregion.py;
+    # docs/multiregion.md).
+    region: RegionConfig = field(default_factory=RegionConfig)
 
 
 @dataclass
@@ -888,6 +1010,10 @@ class DaemonConfig:
     # Guberberg two-tier key table (runtime/coldtier.py;
     # docs/tiering.md): HBM hot slots over a host-RAM cold tier.
     tier: TierConfig = field(default_factory=TierConfig)
+    # Planet-scale active-active regions (runtime/multiregion.py;
+    # docs/multiregion.md): home-region truth, bounded remote carves,
+    # at-most-once WAN reconcile.
+    region: RegionConfig = field(default_factory=RegionConfig)
     # Discovery-update coalescing window in ms (GUBER_PEER_DEBOUNCE_MS):
     # rapid watch events within the window apply as ONE latest-wins
     # remap.  0 = apply every event (still serialized).
@@ -1317,6 +1443,7 @@ def setup_daemon_config(config_file: Optional[str] = None) -> DaemonConfig:
         reshard=reshard_config_from_env(),
         stats=stats_config_from_env(),
         tier=tier_config_from_env(),
+        region=region_config_from_env(),
         peer_debounce_ms=peer_debounce_ms_from_env(),
         reshard_drain_on_close=_env(
             "GUBER_RESHARD_DRAIN_ON_CLOSE", "false"

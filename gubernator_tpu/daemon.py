@@ -282,6 +282,18 @@ class Daemon:
         self.conf = conf or DaemonConfig()
         self.clock = clock
         self.metrics = Metrics()
+        # Region identity (docs/multiregion.md): an enabled region
+        # plane with no explicit name takes the data-center tag — the
+        # region name IS what peers advertise on the wire, so the WAN
+        # split in set_peers and the rendezvous universe agree.
+        # dataclasses.replace re-runs validation with the resolved
+        # name (self-region-in-peer-map).
+        import dataclasses as _dc
+
+        rc = getattr(self.conf, "region", None) or Config().region
+        if rc.enabled and not rc.name and self.conf.data_center:
+            rc = _dc.replace(rc, name=self.conf.data_center)
+        self.region_cfg = rc
         # Flight recorder (runtime/flightrec.py): armed per config; the
         # Metrics bundle carries it to the layers that feed it.
         from gubernator_tpu.runtime.flightrec import recorder_from_config
@@ -382,6 +394,7 @@ class Daemon:
             lease=getattr(self.conf, "lease", None) or Config().lease,
             stats=getattr(self.conf, "stats", None) or Config().stats,
             tier=getattr(self.conf, "tier", None) or Config().tier,
+            region=self.region_cfg,
         )
         peer_creds = (
             self.tls.client_credentials() if self.tls is not None else None
@@ -849,6 +862,10 @@ class Daemon:
                     **s.reshard.debug_vars(),
                     "peer_updates_applied": self.peer_updates_applied,
                 }
+            if s.regions is not None:
+                # Region carve plane (docs/multiregion.md): home
+                # universe, drift backlog, per-link heal states.
+                out["region"] = s.regions.debug_vars()
         if s is not None and s.tenants is not None:
             # Gubstat per-tenant admission ledger (docs/observability.md).
             out["tenants"] = s.tenants.debug_vars()
@@ -1014,6 +1031,23 @@ class Daemon:
         Serialized: concurrent callers (the discovery applier, the
         cluster fixture) apply one at a time, in call order."""
         me = self.advertise_address()
+        peers = list(peers)
+        if self.region_cfg.enabled and self.region_cfg.peers:
+            # WAN seed merge (docs/multiregion.md): the configured
+            # remote-region addresses ride along with EVERY discovery
+            # kind — in-region discovery (dns/gossip/k8s/etcd) only
+            # sees its own mesh, and a region partition must not
+            # evict the seed arcs we will need to reconcile over.
+            have = {p.grpc_address for p in peers}
+            for rname, addrs in sorted(self.region_cfg.peers.items()):
+                if rname == self.region_cfg.name:
+                    continue
+                for a in addrs:
+                    if a and a not in have:
+                        have.add(a)
+                        peers.append(PeerInfo(
+                            grpc_address=a, data_center=rname
+                        ))
         marked = [
             PeerInfo(
                 grpc_address=p.grpc_address,

@@ -118,7 +118,8 @@ def run_scenario(
             **overrides,
         )
         cluster = Cluster.start_with(
-            [""] * num_daemons, conf_template=conf
+            list(spec.datacenters) or [""] * num_daemons,
+            conf_template=conf,
         )
         own_cluster = True
     elif cluster is not None:

@@ -1,4 +1,4 @@
-"""The scenario library (docs/loadgen.md): six declarative open-loop
+"""The scenario library (docs/loadgen.md): seven declarative open-loop
 scenarios, each ending in a pass/fail verdict asserted from the merged
 /debug/vars ledger — admission bounds exactly, shed/over-admission
 attribution, reconvergence after heal.  No scenario reports latency
@@ -420,10 +420,147 @@ PARTITION_LEASED = ScenarioSpec(
     },
     needs_cluster=True,
 )
+# -- region_failover ---------------------------------------------------
+
+_REGION_FRACTION = 0.25
+
+
+def _region_conf_overrides() -> Dict:
+    from ..core.config import CircuitConfig, RegionConfig
+
+    return {
+        "region": RegionConfig(
+            enabled=True, fraction=_REGION_FRACTION,
+            reconcile_ms=200, drift_max=100_000,
+        ),
+        # Fast breaker schedule so the WAN reconcile arcs re-close
+        # inside the heal phase budget.
+        "circuit": CircuitConfig(
+            failure_threshold=3, base_backoff_s=0.1,
+            max_backoff_s=1.0, jitter=0.2,
+        ),
+    }
+
+
+async def _region_partition(ctx: RunContext) -> None:
+    """Sever the WAN: cut the cluster along its data-center groups.
+    Client traffic keeps flowing to BOTH regions — active-active means
+    the partition is invisible on the request path (remote-homed keys
+    keep serving from their bounded carve; burns queue as drift)."""
+    groups: Dict[str, set] = {}
+    for d in ctx.cluster.daemons:
+        groups.setdefault(d.conf.data_center, set()).add(d.grpc_address)
+    assert len(groups) >= 2, f"region_failover needs >= 2 regions: {groups}"
+    ctx.injector.set_active(True)
+    ctx.injector.partition(*groups.values())
+    ctx.state["region_groups"] = groups
+
+
+async def _region_heal(ctx: RunContext) -> None:
+    ctx.injector.heal()
+
+
+def _region_verdict(ctx: RunContext) -> Dict:
+    import time as _t
+
+    spec = ctx.spec
+    carve_per_key = int(spec.limit * _REGION_FRACTION)
+    keys = spec.key_universe
+
+    # Reconvergence from the region surface first: every daemon's
+    # drift drains to zero and every degraded link re-homes through
+    # REGION_PREPARE -> TRANSFER -> CUTOVER back to remote.
+    deadline = _t.monotonic() + 25.0
+    while True:
+        vars_ = [d.service.regions.debug_vars() for d in ctx.daemons]
+        drained = all(v["drift"] == 0 for v in vars_)
+        rehomed = all(
+            lk["state"] == "remote"
+            for v in vars_ for lk in v["links"].values()
+        )
+        if drained and rehomed:
+            break
+        if _t.monotonic() > deadline:
+            raise AssertionError(
+                f"region_failover: drift never reconverged: {vars_}"
+            )
+        _t.sleep(0.2)
+    dropped = sum(v["reconcile_dropped"] for v in vars_)
+    assert dropped == 0, (
+        f"region_failover: {dropped} burns dropped as ambiguous — a "
+        "clean partition is provably-unsent, nothing may drop"
+    )
+    rehomes = sum(v["rehomes"] for v in vars_)
+    assert rehomes >= 1, (
+        f"region_failover: no link ever re-homed after heal: {vars_}"
+    )
+
+    totals = ctx.totals()
+    # Active-active is the point: a region partition produces ZERO
+    # client-visible errors — the request path never crosses the WAN.
+    assert totals.errors == 0, (
+        f"region_failover: {totals.errors} client-visible errors — "
+        "the partition leaked onto the request path"
+    )
+    # The paper bound on the client surface: per key at most
+    # limit x (1 + remote_regions x fraction) unique admissions.
+    client_bound = keys * int(spec.limit * (1 + _REGION_FRACTION))
+    assert totals.admitted <= client_bound, (
+        f"region_failover: client admissions {totals.admitted} > "
+        f"bound {client_bound}"
+    )
+
+    t = merged_tenant(ctx.daemons, spec.tenant)
+    over = t["over_admitted"].get("region-carve", 0)
+    assert 0 < over <= carve_per_key * keys, (
+        f"region_failover: region-carve over-admission {over} outside "
+        f"(0, {carve_per_key} x {keys}] — the carve plane is unbounded "
+        "or never served"
+    )
+    # Ledger allowance: each carve admission counts once at the carve
+    # (over-admission) and its reconciled burn may count once more at
+    # the home row — hence 2 x the carve budget on top of the base.
+    facts = assert_admission_bound(
+        ctx, extra_allowance=2 * carve_per_key * keys
+    )
+    facts.update({
+        "region_carve_over": over,
+        "region_rehomes": rehomes,
+        "region_drift": 0,
+        "client_admission_bound": client_bound,
+    })
+    facts.update(assert_reconverged(ctx))
+    return facts
+
+
+REGION_FAILOVER = ScenarioSpec(
+    name="region_failover",
+    description="A two-region active-active cluster is cut in half "
+    "mid-run: remote-homed keys keep serving from their bounded "
+    "region carve with zero client-visible errors, drift reconverges "
+    "after heal, every link re-homes, and the merged ledger keeps "
+    "region-carve over-admission within fraction x limit per key.",
+    phases=(
+        PhaseSpec("steady", 0.3, "steady", "uniform"),
+        PhaseSpec("partition", 0.4, "steady", "uniform",
+                  fault="partition", profile=True),
+        PhaseSpec("heal", 0.3, "steady", "uniform", fault="heal"),
+    ),
+    limit=200, window_ms=WINDOW_MS, key_universe=24,
+    tenant="load.region", verdict=_region_verdict,
+    hooks={
+        "partition": _region_partition,
+        "heal": _region_heal,
+    },
+    needs_cluster=True,
+    datacenters=("east", "east", "west", "west"),
+)
+
+
 SCENARIOS = {
     s.name: s
     for s in (STEADY, DIURNAL, BURSTSTORM, FLASHCROWD, RESHARD_CHURN,
-              PARTITION_LEASED)
+              PARTITION_LEASED, REGION_FAILOVER)
 }
 
 def _churn_conf_overrides() -> Dict:
@@ -442,6 +579,7 @@ def _churn_conf_overrides() -> Dict:
 CONF_OVERRIDES = {
     "partition_leased": _lease_conf_overrides,
     "reshard_churn": _churn_conf_overrides,
+    "region_failover": _region_conf_overrides,
 }
 
 

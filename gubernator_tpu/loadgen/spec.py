@@ -56,6 +56,12 @@ class ScenarioSpec:
     verdict: Callable[["RunContext"], Dict]
     hooks: Dict[str, Callable] = field(default_factory=dict)
     needs_cluster: bool = False
+    # Per-daemon data-center tags for the booted cluster (empty =
+    # `num_daemons` single-region daemons).  Multi-region scenarios
+    # (docs/multiregion.md) pin their topology here — the region name
+    # IS the data-center tag, so ["east","east","west","west"] boots
+    # two two-node regions.
+    datacenters: Tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         if not self.phases:

@@ -343,7 +343,8 @@ demote_extract = jax.jit(
 # and passes their fingerprints per class; the kernel counts which are
 # live residents.  Order is a wire contract with runtime/gubstat.py.
 SHADOW_PLANES = (
-    ".hot-mirror", ".lease-grant", ".degraded-shadow", ".handoff-shadow"
+    ".hot-mirror", ".lease-grant", ".degraded-shadow",
+    ".handoff-shadow", ".region-carve",
 )
 
 # Slot-age / TTL-remaining histogram edges (ms): <=1s, <=10s, <=1m,

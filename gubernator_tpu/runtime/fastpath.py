@@ -708,6 +708,16 @@ class FastPath:
             # compiled lane.
             self.fallbacks += 1
             return None
+        if self.s.regions is not None:
+            # Planet-scale regions (docs/multiregion.md): a remote-homed
+            # key must serve the bounded `.region-carve` slot, and the
+            # home pick is a per-key rendezvous over STRING hashes
+            # (`key@region`) the columnar router cannot express — served
+            # on the compiled lane it would answer from the raw row at
+            # the full limit, breaking the region bound.  The object
+            # path owns region routing.
+            self.fallbacks += 1
+            return None
         routed = not peer_rpc and not self._single_node()
         if routed and not self._can_route():
             self.fallbacks += 1

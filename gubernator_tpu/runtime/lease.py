@@ -114,6 +114,18 @@ class LeaseManager:
         """One holder's allowance for a limit — the carve unit."""
         return max(1, int(limit * self.cfg.fraction))
 
+    def _leasable_limit(self, req: RateLimitReq) -> int:
+        """The budget a grant may carve from.  A key homed in another
+        REGION is itself served from this region's bounded
+        `.region-carve` slot (docs/multiregion.md), so the lease
+        fraction nests inside the region fraction — carving from the
+        full limit here would hand holders budget this region never
+        owned."""
+        rm = getattr(self.s, "regions", None)
+        if rm is not None and rm.remote_home(req.hash_key()) is not None:
+            return max(1, int(req.limit * rm.fraction))
+        return req.limit
+
     def refusal_for(self, req: RateLimitReq) -> str:
         """Why this limit cannot be leased; empty = leasable."""
         if not req.unique_key:
@@ -228,7 +240,9 @@ class LeaseManager:
             self._refresh_gauge()
             return out
 
-        allowances = [self.allowance_of(r.limit) for r in carve_reqs]
+        allowances = [
+            self.allowance_of(self._leasable_limit(r)) for r in carve_reqs
+        ]
         slots = [
             dc_replace(
                 r,
@@ -360,6 +374,22 @@ class LeaseManager:
         flush lands on the key's owner wherever it is.  A peer-less
         single node applies directly through the local check path (the
         flush would have nowhere to route)."""
+        rm = getattr(self.s, "regions", None)
+        if rm is not None:
+            # Remote-homed burns belong to the region reconcile lane:
+            # the WAN flush routes them to the key's HOME region with
+            # the same at-most-once discipline (a queue_hit flush
+            # would land them on an in-region peer that is not truth).
+            rest: List[RateLimitReq] = []
+            for r in burned:
+                home = rm.remote_home(r.hash_key())
+                if home is not None:
+                    rm.queue_burn(home, dc_replace(r))
+                else:
+                    rest.append(r)
+            burned = rest
+            if not burned:
+                return
         if self.s.local_picker.size() == 0:
             reads = [
                 dc_replace(
@@ -432,6 +462,34 @@ class LeaseManager:
             self._note_revocation("remap", revoked)
         if drops:
             await self._drop_slots(drops, reason="remap")
+        self._refresh_gauge()
+        return revoked
+
+    async def drop_rehomed(self, region: str) -> int:
+        """Revoke holder records and drop carve slots for keys homed
+        in `region` — the region-cutover analog of drop_unowned
+        (docs/multiregion.md).  A healed home region re-asserts
+        authority over its keys; grants carved here from the region
+        fraction must not keep renewing against it, so holders
+        re-acquire and their next grant sizes against the live
+        topology."""
+        rm = getattr(self.s, "regions", None)
+        if rm is None:
+            return 0
+        drops: List[RateLimitReq] = []
+        revoked = 0
+        with self._lock:
+            for key in list(self._keys):
+                if rm.home_region(key) != region:
+                    continue
+                ks = self._keys.pop(key)
+                revoked += len(ks.holders)
+                if ks.slot_reset is not None:
+                    drops.append(ks.slot_reset)
+        if revoked:
+            self._note_revocation("rehome", revoked)
+        if drops:
+            await self._drop_slots(drops, reason="rehome")
         self._refresh_gauge()
         return revoked
 

@@ -406,6 +406,41 @@ class Metrics:
             registry=r,
         )
 
+        # -- region carve plane (runtime/multiregion.py;
+        #    docs/multiregion.md) --------------------------------------
+        self.region_drift = Gauge(
+            "gubernator_region_drift_hits",
+            "Un-reconciled carve burns queued toward remote home "
+            "regions (the bounded-divergence backlog; capped by "
+            "GUBER_REGION_DRIFT_MAX).",
+            registry=r,
+        )
+        self.region_carve_served = Counter(
+            "gubernator_region_carve_served_total",
+            "Checks served from a local .region-carve slot for a "
+            "remote-homed key.",
+            registry=r,
+        )
+        self.region_reconcile_lag = Histogram(
+            "gubernator_region_reconcile_lag_seconds",
+            "Queue-to-delivery latency of carve burns reconciling to "
+            "their home region over the WAN lane.",
+            buckets=LATENCY_BUCKETS,
+            registry=r,
+        )
+        self.region_rehomes = Counter(
+            "gubernator_region_rehomes_total",
+            "Completed region re-home pipelines (REGION_PREPARE -> "
+            "TRANSFER -> CUTOVER after a WAN heal).",
+            registry=r,
+        )
+        self.region_degraded = Counter(
+            "gubernator_region_degraded_total",
+            "Region links marked degraded (WAN lane provably down; "
+            "carve keeps serving local_shadow semantics).",
+            registry=r,
+        )
+
         # -- cache / device table (lrucache.go:48-59) ---------------------
         self.cache_access_count = Counter(
             "gubernator_cache_access_count",
@@ -647,8 +682,9 @@ class Metrics:
         self.table_shadow_slots = Gauge(
             "gubernator_table_shadow_slots",
             "Resident live slots per shadow plane (hot-mirror, "
-            "lease-grant, degraded-shadow, handoff-shadow) matched "
-            "against the enumerated derived-key fingerprints.",
+            "lease-grant, degraded-shadow, handoff-shadow, "
+            "region-carve) matched against the enumerated derived-key "
+            "fingerprints.",
             ["plane"],
             registry=r,
         )

@@ -271,6 +271,17 @@ class Service:
         # handoff's covered-key test (reshard.inbound_covering).
         self._prev_picker = None
         self._reshard_watch_task: Optional[asyncio.Task] = None
+        # Planet-scale regions (runtime/multiregion.py;
+        # docs/multiregion.md): remote-homed keys serve from a bounded
+        # `.region-carve` slot and reconcile over the WAN lane.  None
+        # when disabled — every key is then home here.
+        self.regions = None
+        if self.cfg.region.enabled:
+            from gubernator_tpu.runtime.multiregion import RegionManager
+
+            self.regions = RegionManager(
+                self, self.cfg.region, metrics=self.metrics
+            )
         self.global_mgr = GlobalManager(self)
         self.multi_region_mgr = MultiRegionManager(self)
         # On a mesh backend, GLOBAL keys owned by THIS node serve from the
@@ -311,6 +322,8 @@ class Service:
         self._loop = asyncio.get_running_loop()
         self.global_mgr.start()
         self.multi_region_mgr.start()
+        if self.regions is not None:
+            self.regions.start()
         if self._collective_loop is not None:
             self._collective_loop.start()
         if self.leases is not None:
@@ -382,6 +395,8 @@ class Service:
         if self.leases is not None and old_local.size() > 0:
             self.leases.on_remap()
         self._invalidate_unowned_mirrors()
+        if self.regions is not None:
+            self.regions.on_remap()
 
         shutdown: List[PeerClient] = []
         for peer in old_local.peers():
@@ -462,6 +477,8 @@ class Service:
                     keys.extend(
                         k + HANDOFF_SUFFIX for k in ib.shadow
                     )
+        if self.regions is not None:
+            keys.extend(self.regions.carve_slot_keys())
         return keys
 
     def derived_slot_fps(self) -> np.ndarray:
@@ -952,6 +969,7 @@ class Service:
         forwards: List[Tuple[int, PeerClient, RateLimitReq, str]] = []
         mirrors: List[Tuple[int, PeerClient, RateLimitReq]] = []
         covered: List[Tuple[int, RateLimitReq, str, object]] = []
+        region_serves: List[Tuple[int, RateLimitReq, str, str]] = []
 
         reqs = self._strip_sketch_global(reqs)
 
@@ -996,7 +1014,22 @@ class Service:
                 continue
             key = req.hash_key()
             is_global = has_behavior(req.behavior, Behavior.GLOBAL)
+            # Region routing (docs/multiregion.md): a key whose HOME
+            # region is elsewhere serves from the bounded local
+            # `.region-carve` slot at the in-region owner — never a
+            # WAN round-trip on the request path.  GLOBAL and legacy
+            # MULTI_REGION traffic keep their own replication lanes.
+            region_home: Optional[str] = None
+            if (
+                self.regions is not None
+                and not is_global
+                and not has_behavior(req.behavior, Behavior.MULTI_REGION)
+            ):
+                region_home = self.regions.remote_home(key)
             if single_node:
+                if region_home is not None:
+                    region_serves.append((i, req, key, region_home))
+                    continue
                 if is_global and self.global_engine is not None:
                     self.metrics.getratelimit_counter.labels("global").inc()
                     engine_idx.append(i)
@@ -1018,6 +1051,13 @@ class Service:
                 )
                 continue
             if peer.info().is_owner:
+                if region_home is not None:
+                    # In-region owner of a remote-homed key: the one
+                    # node in this region that carves for it (one
+                    # carve per region, not one per node — the bound
+                    # counts regions).
+                    region_serves.append((i, req, key, region_home))
+                    continue
                 rs = self.reshard
                 if rs is not None and rs.active() and not is_global:
                     # Live resharding (docs/resharding.md): a key whose
@@ -1057,7 +1097,7 @@ class Service:
                 local_cached.append(True)
                 local_owner_meta.append(peer.info().grpc_address)
                 self.global_mgr.queue_hit(req)
-            elif self._mirror_eligible(req, key, peer):
+            elif region_home is None and self._mirror_eligible(req, key, peer):
                 # Hot-key widening (docs/hotkeys.md): the owner is
                 # measurably pressured and this node is one of the
                 # key's next-arc mirrors — serve from the local
@@ -1079,6 +1119,10 @@ class Service:
                 self.reshard.serve_covered(req, key, ib)
             )
             for (_, req, key, ib) in covered
+        ]
+        region_tasks = [
+            asyncio.ensure_future(self.regions.serve(req, key, home))
+            for (_, req, key, home) in region_serves
         ]
 
         try:
@@ -1135,6 +1179,18 @@ class Service:
                     if isinstance(resp, BaseException):
                         responses[i] = RateLimitResp(
                             error=f"Error serving resharding key "
+                            f"'{key}': {resp}"
+                        )
+                    else:
+                        responses[i] = resp
+            if region_tasks:
+                results = await asyncio.gather(
+                    *region_tasks, return_exceptions=True
+                )
+                for (i, _, key, _home), resp in zip(region_serves, results):
+                    if isinstance(resp, BaseException):
+                        responses[i] = RateLimitResp(
+                            error=f"Error serving region carve for "
                             f"'{key}': {resp}"
                         )
                     else:
@@ -1667,6 +1723,25 @@ class Service:
                     bulk_key_hash64([r.hash_key() for r in valid]),
                     np.array([r.hits for r in valid], dtype=np.int64),
                 )
+        special: Dict[int, object] = {}
+        if self.regions is not None:
+            # Region routing (docs/multiregion.md): a forwarded check
+            # for a remote-homed key lands here because this node is
+            # the key's in-region owner — serve the bounded
+            # `.region-carve` slot, never the raw row at full limit.
+            # The WAN reconcile lane arrives at the HOME region's
+            # owner, where remote_home() is None, and applies below.
+            for i, r in enumerate(reqs):
+                if not r.unique_key or not r.name:
+                    continue
+                if has_behavior(r.behavior, Behavior.GLOBAL):
+                    continue
+                if has_behavior(r.behavior, Behavior.MULTI_REGION):
+                    continue
+                key = r.hash_key()
+                home = self.regions.remote_home(key)
+                if home is not None:
+                    special[i] = ("region", key, home)
         rs = self.reshard
         if rs is not None and rs.active():
             # Live resharding (docs/resharding.md): forwarded checks
@@ -1675,9 +1750,12 @@ class Service:
             # flight) forward back / serve the bounded shadow; rerouted
             # outbound keys (our rows are gone — post-TRANSFER or a
             # draining leaver) forward to the new owner.  Everything
-            # else applies locally as usual.
-            special: Dict[int, object] = {}
+            # else applies locally as usual.  (Remote-homed keys keep
+            # their region dispatch: the carve slot is a derived slot
+            # and migrates with the arc.)
             for i, r in enumerate(reqs):
+                if i in special:
+                    continue
                 if not r.unique_key or not r.name:
                     continue
                 if has_behavior(r.behavior, Behavior.GLOBAL):
@@ -1692,38 +1770,40 @@ class Service:
                     tp = self.local_picker.get_by_address(tgt)
                     if tp is not None:
                         special[i] = ("reroute", key, tp)
-            if special:
-                async def _serve_special(spec, r):
-                    kind, key, arg = spec
-                    if kind == "covered":
-                        return await rs.serve_covered(r, key, arg)
-                    return await self._forward(arg, r, key)
+        if special:
+            async def _serve_special(spec, r):
+                kind, key, arg = spec
+                if kind == "region":
+                    return await self.regions.serve(r, key, arg)
+                if kind == "covered":
+                    return await rs.serve_covered(r, key, arg)
+                return await self._forward(arg, r, key)
 
-                kept = [
-                    r for i, r in enumerate(reqs) if i not in special
-                ]
-                inner_task = asyncio.gather(*(
-                    _serve_special(special[i], reqs[i])
-                    for i in sorted(special)
-                ), return_exceptions=True)
-                inner = (
-                    await self._check_local(kept) if kept else []
-                )
-                spec_resps = dict(zip(sorted(special), await inner_task))
-                it = iter(inner)
-                out: List[RateLimitResp] = []
-                for i, r in enumerate(reqs):
-                    if i in special:
-                        resp = spec_resps[i]
-                        if isinstance(resp, BaseException):
-                            resp = RateLimitResp(
-                                error="Error serving resharding key "
-                                f"'{r.hash_key()}': {resp}"
-                            )
-                        out.append(resp)
-                    else:
-                        out.append(next(it))
-                return out
+            kept = [
+                r for i, r in enumerate(reqs) if i not in special
+            ]
+            inner_task = asyncio.gather(*(
+                _serve_special(special[i], reqs[i])
+                for i in sorted(special)
+            ), return_exceptions=True)
+            inner = (
+                await self._check_local(kept) if kept else []
+            )
+            spec_resps = dict(zip(sorted(special), await inner_task))
+            it = iter(inner)
+            out: List[RateLimitResp] = []
+            for i, r in enumerate(reqs):
+                if i in special:
+                    resp = spec_resps[i]
+                    if isinstance(resp, BaseException):
+                        resp = RateLimitResp(
+                            error="Error serving forwarded key "
+                            f"'{r.hash_key()}': {resp}"
+                        )
+                    out.append(resp)
+                else:
+                    out.append(next(it))
+            return out
         shed = self.shed_level()
         if shed:
             # Owner-side shedding of forwarded traffic — the relief
@@ -1892,6 +1972,8 @@ class Service:
             await self._collective_loop.close()
         await self.global_mgr.close()
         await self.multi_region_mgr.close()
+        if self.regions is not None:
+            await self.regions.close()
         await self._local_batcher.close()
         if self.cfg.loader is not None:
             loop = asyncio.get_running_loop()

@@ -2,9 +2,10 @@
 cluster (the compressed version of tests/test_chaos.py +
 tests/test_hotkey.py).
 
-Scenarios (--scenario storm|hotkey|lease|reshard|coldstorm|all;
-default storm — the original job; CI runs hotkey, lease, reshard and
-coldstorm as their own required steps):
+Scenarios (--scenario storm|hotkey|lease|reshard|coldstorm|
+regionsplit|all; default storm — the original job; CI runs hotkey,
+lease, reshard, coldstorm and regionsplit as their own required
+steps):
 
   storm   a seeded storm of client/server faults (>=30% of peer RPCs
           fail) with breakers + `local_shadow` degraded mode armed:
@@ -53,6 +54,18 @@ coldstorm as their own required steps):
           kill + restart: the checkpoint restores BOTH tiers (cold
           residents + HBM occupancy conserved) and an exhausted key
           stays denied — no limit reset.
+
+  regionsplit a two-region active-active cluster cut in half
+          (docs/multiregion.md): a west-homed key keeps serving from
+          east's bounded `.region-carve` slot while the WAN is severed
+          — east admits EXACTLY fraction x limit, west saturates the
+          authoritative row, total admission lands exactly on
+          limit x (1 + regions x fraction) with the merged /debug/vars
+          ledger showing region-carve over-admission == the carve.
+          After heal the burn backlog reconciles at-most-once into the
+          (saturated) home row, drift reconverges to zero, the link
+          re-homes through REGION_PREPARE -> TRANSFER -> CUTOVER, and
+          the carve keeps its consumed state (no per-heal refresh).
 
 On any failure each daemon's flight recorder dumps its ring to
 GUBER_FLIGHTREC_DIR (default flightrec-dumps/) so the CI artifact step
@@ -1135,13 +1148,240 @@ def coldstorm_scenario(seed: int) -> None:
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def regionsplit_scenario(seed: int) -> None:
+    """Planet-scale region partition (docs/multiregion.md acceptance)."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.core.config import (
+        CircuitConfig,
+        DaemonConfig,
+        RegionConfig,
+    )
+    from gubernator_tpu.core.types import RateLimitReq, Status
+    from gubernator_tpu.testing import ChaosInjector, ChaosPlan, Cluster
+
+    limit = 200
+    fraction = 0.25
+    carve = int(limit * fraction)  # 50
+    bound = int(limit * (1 + 1 * fraction))  # 250: one remote region
+    injector = ChaosInjector(ChaosPlan(seed=seed))
+    injector.set_active(False)  # boot runs clean
+    cluster = Cluster.start_with(
+        ["east", "east", "west", "west"],
+        conf_template=DaemonConfig(
+            region=RegionConfig(
+                enabled=True, fraction=fraction, reconcile_ms=200,
+                drift_max=10_000,
+            ),
+            circuit=CircuitConfig(
+                failure_threshold=3, base_backoff_s=0.1,
+                max_backoff_s=1.0, jitter=0.2,
+            ),
+            chaos=injector,
+            flightrec=True,
+            flightrec_dir=os.environ.get(
+                "GUBER_FLIGHTREC_DIR", "flightrec-dumps"
+            ),
+        ),
+    )
+    try:
+        east = [d for d in cluster.daemons if d.conf.data_center == "east"]
+        west = [d for d in cluster.daemons if d.conf.data_center == "west"]
+        rm = east[0].service.regions
+        assert sorted(rm.universe()) == ["east", "west"], rm.universe()
+        # Every daemon agrees on every home pick (the rendezvous needs
+        # only the shared universe, no coordination rounds).
+        for i in range(20):
+            homes = {
+                d.service.regions.home_region(f"region_R{i}")
+                for d in cluster.daemons
+            }
+            assert len(homes) == 1, f"home split-brain for R{i}: {homes}"
+
+        def admitted_of(resps):
+            return sum(
+                1 for r in resps
+                if r.error == "" and r.status == Status.UNDER_LIMIT
+            )
+
+        def east_region_vars():
+            return [d.service.regions.debug_vars() for d in east]
+
+        # -- phase A (healthy WAN): the carve serves a west-homed key
+        # from east with zero WAN RTT, and the burns reconcile into
+        # the home region's row exactly once.
+        warm = next(
+            f"H{i}" for i in range(1000)
+            if rm.home_region(f"regionwarm_H{i}") == "west"
+        )
+        warm_req = RateLimitReq(name="regionwarm", unique_key=warm,
+                                hits=1, limit=limit, duration=DURATION)
+        warm_burn = 5
+        cl_e = [V1Client(d.grpc_address) for d in east]
+        cl_w = V1Client(west[0].grpc_address)
+        try:
+            for i in range(warm_burn):
+                r = cl_e[i % 2].get_rate_limits([warm_req], timeout=30)[0]
+                assert r.error == "" and r.status == Status.UNDER_LIMIT, r
+                md = r.metadata or {}
+                assert md.get("region") == "west", md
+                assert md.get("region_serve") == "carve", md
+            deadline = time.monotonic() + 15.0
+            while sum(v["drift"] for v in east_region_vars()) > 0:
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        "healthy-WAN drift never drained: "
+                        f"{east_region_vars()}"
+                    )
+                time.sleep(0.1)
+            consumed = sum(
+                limit - int(row.remaining)
+                for d in west
+                for row in [d.service.backend.get_cache_item(
+                    f"regionwarm_{warm}"
+                )]
+                if row is not None
+            )
+            assert consumed == warm_burn, (
+                f"home region absorbed {consumed} != {warm_burn} "
+                "burned carve hits (reconcile must be at-most-once)"
+            )
+
+            # -- phase B: PARTITION the regions mid-traffic.  The main
+            # key is untouched until now, so the bound arithmetic is
+            # exact: carve admissions all happen under partition.
+            key = next(
+                f"R{i}" for i in range(1000)
+                if rm.home_region(f"region_R{i}") == "west"
+            )
+            req = RateLimitReq(name="region", unique_key=key, hits=1,
+                               limit=limit, duration=DURATION)
+            injector.set_active(True)
+            injector.partition(
+                {d.grpc_address for d in east},
+                {d.grpc_address for d in west},
+            )
+
+            # The dark side serves EXACTLY its carve and never more:
+            # east keeps answering from the bounded `.region-carve`
+            # slot while the WAN is severed.
+            admitted = 0
+            for i in range(carve + 30):
+                admitted += admitted_of(
+                    cl_e[i % 2].get_rate_limits([req], timeout=30)
+                )
+            assert admitted == carve, (
+                f"east admitted {admitted} != carve {carve}"
+            )
+            # The un-reconciled backlog IS the divergence, observable.
+            vars_e = east_region_vars()
+            assert sum(v["drift"] for v in vars_e) == carve, vars_e
+            deadline = time.monotonic() + 15.0
+            while not any(
+                lk["state"] == "degraded"
+                for v in east_region_vars()
+                for lk in v["links"].values()
+            ):
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        "link never marked degraded under partition: "
+                        f"{east_region_vars()}"
+                    )
+                time.sleep(0.1)
+
+            # The home region is unaffected: direct traffic saturates
+            # the authoritative row at the full limit.
+            for _ in range(limit + 20):
+                admitted += admitted_of(
+                    cl_w.get_rate_limits([req], timeout=30)
+                )
+            assert admitted == bound, (
+                f"admitted {admitted} != bound {bound} "
+                f"(limit x (1 + regions x fraction))"
+            )
+            # Saturated on BOTH sides of the split: not one hit over.
+            extra = sum(
+                admitted_of(c.get_rate_limits([req], timeout=30))
+                for c in (cl_e[0], cl_e[1], cl_w)
+            )
+            assert extra == 0, "admission past the proven bound"
+
+            # The bound from the LIVE metrics surface
+            # (docs/observability.md): every carve admission counts as
+            # region-carve over-admission in the merged tenant ledger —
+            # EXACTLY the carve, nothing more, even mid-partition.
+            over = _merged_tenant(cluster.daemons, "region")[
+                "over_admitted"
+            ].get("region-carve", 0)
+            assert over == carve, (
+                f"live region-carve over-admission {over} != {carve}"
+            )
+
+            # -- phase C: HEAL.  The backlog flushes at-most-once, the
+            # link re-homes (REGION_PREPARE -> TRANSFER -> CUTOVER),
+            # drift reconverges to zero, and nothing double counts.
+            injector.heal()
+            deadline = time.monotonic() + 20.0
+            while True:
+                vars_e = east_region_vars()
+                drained = sum(v["drift"] for v in vars_e) == 0
+                rehomed = all(
+                    lk["state"] == "remote"
+                    for v in vars_e for lk in v["links"].values()
+                )
+                if drained and rehomed:
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"drift never reconverged after heal: {vars_e}"
+                    )
+                time.sleep(0.1)
+            vars_e = east_region_vars()
+            assert sum(v["rehomes"] for v in vars_e) >= 1, vars_e
+            assert sum(v["reconcile_dropped"] for v in vars_e) == 0, (
+                f"at-most-once violated (ambiguous drops): {vars_e}"
+            )
+            # The late burns landed on a SATURATED home row (denied,
+            # never re-admitted) and the carve slot kept its consumed
+            # state through cutover — no per-heal budget refresh, so
+            # the key stays exhausted everywhere.
+            extra = sum(
+                admitted_of(c.get_rate_limits([req], timeout=30))
+                for c in (cl_e[0], cl_e[1], cl_w)
+            )
+            assert extra == 0, "heal re-admitted past the bound"
+            over = _merged_tenant(cluster.daemons, "region")[
+                "over_admitted"
+            ].get("region-carve", 0)
+            assert over == carve, (
+                f"post-heal region-carve over-admission {over} != "
+                f"{carve} (reconcile double counted)"
+            )
+        finally:
+            for c in cl_e:
+                c.close()
+            cl_w.close()
+
+        print(
+            f"regionsplit smoke OK: seed={seed} key=region_{key} "
+            f"home=west carve={carve}, admitted={bound} == "
+            f"limit x (1 + 1 x {fraction}), drift {carve}->0 after "
+            f"heal, rehomed, ledger region-carve == {carve} exactly"
+        )
+    except BaseException:
+        _dump_flightrec(cluster, "regionsplit-smoke-failure")
+        raise
+    finally:
+        cluster.stop()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument(
         "--scenario",
         choices=(
-            "storm", "hotkey", "lease", "reshard", "coldstorm", "all"
+            "storm", "hotkey", "lease", "reshard", "coldstorm",
+            "regionsplit", "all"
         ),
         default="storm",
     )
@@ -1156,6 +1396,8 @@ def main() -> None:
         reshard_scenario(args.seed)
     if args.scenario in ("coldstorm", "all"):
         coldstorm_scenario(args.seed)
+    if args.scenario in ("regionsplit", "all"):
+        regionsplit_scenario(args.seed)
 
 
 if __name__ == "__main__":

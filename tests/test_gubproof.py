@@ -21,6 +21,8 @@ from tools.gubproof.explore import explore_model
 from tools.gubproof.models import (
     BreakerModel,
     LeaseModel,
+    RegionModel,
+    RegionReshardModel,
     ReshardLeaseModel,
     ReshardModel,
     TierModel,
@@ -39,7 +41,9 @@ def _errors(findings):
 # -- specs ----------------------------------------------------------------
 def test_all_specs_load_and_validate():
     specs = load_all_specs()
-    assert {s.id for s in specs} == {"breaker", "lease", "reshard", "tier"}
+    assert {s.id for s in specs} == {
+        "breaker", "lease", "region", "reshard", "tier"
+    }
     for s in specs:
         assert s.bound.formula
         assert s.machines
@@ -147,6 +151,20 @@ def test_reshard_lease_composition_exact():
     assert res.max_counters == {"admitted_clean": 7, "admitted_lost": 11}
 
 
+def test_region_bound_exact():
+    # L=4, R=2, fraction=1/4: admitted <= L(1 + (R-1)*f) == 5,
+    # reached, partitioned or not — the carve is never reset at heal.
+    res = _explore(RegionModel(load_all_specs()))
+    assert res.max_counters == {"admitted": 5}
+
+
+def test_region_reshard_composition_exact():
+    # Home region reshards while a remote region carves:
+    # L(1 + f_handoff) + f_region*L == 6 clean, +L when rows are lost.
+    res = _explore(RegionReshardModel(load_all_specs()))
+    assert res.max_counters == {"admitted_clean": 6, "admitted_lost": 10}
+
+
 def test_every_spec_edge_fires_in_some_model():
     specs = load_all_specs()
     fired = set()
@@ -204,6 +222,36 @@ def test_counterexample_round_trips_into_chaos_plan():
     # Self-description survives for humans.
     assert plan["model"] == "reshard-no-replay-guard"
     assert plan["trace"] == list(v.trace)
+
+
+def test_broken_region_cutover_reset_yields_counterexample():
+    # Restoring the carve allowance at cutover hands the remote region
+    # a fresh fraction per heal: partition -> burn -> heal -> burn
+    # breaks both the bound and conservation.
+    res = explore_model(RegionModel(load_all_specs(), cutover_reset=True))
+    assert res.closed
+    assert res.violations, "cutover reset must break the carve algebra"
+    v = res.violations[0]
+    assert v.kind == "invariant"
+    assert "fault:partition" in v.trace
+    assert "rehome:remote" in v.trace
+
+
+def test_region_counterexample_round_trips_into_chaos_plan():
+    res = explore_model(RegionModel(load_all_specs(), cutover_reset=True))
+    v = res.violations[0]
+    plan = plan_from_trace(
+        "region-cutover-reset", list(v.trace), v.message, seed=11
+    )
+    cp = ChaosPlan.from_dict(plan)
+    assert cp.seed == 11
+    assert cp.rules, "a fault trace must lower to at least one rule"
+    # The partition lowers to a provably-unsent WAN refusal: the peer
+    # batch RPC errors client-side BEFORE send, so the reconcile lane
+    # re-queues instead of double counting.
+    wan = [r for r in cp.rules if r.method == "*GetPeerRateLimits*"]
+    assert any(r.phase == "before" and r.where == "client" for r in wan)
+    assert plan["model"] == "region-cutover-reset"
 
 
 # -- CLI / runner ----------------------------------------------------------

@@ -146,6 +146,7 @@ def test_table_stats_matches_numpy_reference():
     grid[0, 1] = plant(10**9 + 9, now - 1)           # expired mirror
     grid[1, 0] = plant(10**9 + 21, now + 60_000)     # live lease carve
     grid[3, 0] = 10**9 + 33                          # enumerated, absent
+    grid[4, 0] = plant(10**9 + 41, now + 60_000)     # live region carve
 
     table = type(table)(**leaves)
     st = table_stats(table, grid, np.int64(now), ways=ways)
@@ -164,8 +165,8 @@ def test_table_stats_matches_numpy_reference():
     )
     np.testing.assert_array_equal(np.asarray(st.shadow_slots), shadow)
     # The planted plan itself: 1 live mirror (expired one not counted),
-    # 1 lease carve, absent handoff fp not counted.
-    assert list(np.asarray(st.shadow_slots)) == [1, 1, 0, 0]
+    # 1 lease carve, absent handoff fp not counted, 1 region carve.
+    assert list(np.asarray(st.shadow_slots)) == [1, 1, 0, 0, 1]
     # Histogram masses account for exactly the live population.
     assert int(np.asarray(st.slot_age).sum()) == live
     assert int(np.asarray(st.ttl_remaining).sum()) == live

@@ -273,9 +273,14 @@ def test_scenario_library_complete():
             if p.fault is not None:
                 assert p.fault in spec.hooks
     # The fault scenarios that make this a harness, present by name.
-    assert {"reshard_churn", "partition_leased"} <= set(SCENARIOS)
+    assert {
+        "reshard_churn", "partition_leased", "region_failover",
+    } <= set(SCENARIOS)
     assert SCENARIOS["reshard_churn"].needs_cluster
     assert SCENARIOS["partition_leased"].needs_cluster
+    assert SCENARIOS["region_failover"].needs_cluster
+    # A multi-region scenario pins its two-region topology.
+    assert len(set(SCENARIOS["region_failover"].datacenters)) == 2
 
 
 def test_scenario_spec_rejects_dangling_fault_hook():

@@ -1,0 +1,582 @@
+"""Planet-scale active-active regions (docs/multiregion.md).
+
+Unit tier: knob validation naming the env surface, the deterministic
+rendezvous home pick (agreement across views, monotonic universe), the
+carve serve path (bounded slot, deny-all, drift_max refusal, rehome
+pause), the at-most-once reconcile discipline (provably-unsent
+re-queues + degrades, ambiguous drops), and the heal state machine —
+including the rejoin-over-reshard regression (a placement change while
+degraded drops ONLY the moved carve slots; surviving slots keep their
+consumed state) and the lease-in-remote-region regression (grants
+carve from the region fraction, CUTOVER revokes them).
+
+Cluster tier: a two-region cluster serves a remote-homed key from the
+`.region-carve` slot at EXACTLY fraction x limit and the burns
+reconcile into the home region's authoritative row.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import replace as dc_replace
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.core.config import (
+    DaemonConfig,
+    LeaseConfig,
+    RegionConfig,
+    _parse_region_peers,
+    region_config_from_env,
+)
+from gubernator_tpu.core.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
+from gubernator_tpu.net.peer_client import PeerNotReadyError
+from gubernator_tpu.runtime.lease import _Holder, _KeyState, LeaseManager
+from gubernator_tpu.runtime.multiregion import (
+    REGION_DEGRADED,
+    REGION_PREPARE,
+    REGION_REMOTE,
+    REGION_SUFFIX,
+    RegionManager,
+)
+
+LIMIT = 100
+DURATION = 60_000
+
+
+def until_pass(fn, timeout=20.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return fn()
+        except AssertionError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(interval)
+
+
+def _req(key, name="t", hits=1, limit=LIMIT, **kw) -> RateLimitReq:
+    return RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=DURATION, **kw,
+    )
+
+
+# ---------------------------------------------------------------------
+# fakes: a WAN peer, its picker, and the service surface RegionManager
+# actually touches
+# ---------------------------------------------------------------------
+
+class _WanPeer:
+    """fail=None delivers; "unsent" raises before any delivery
+    (PeerNotReadyError — provably unsent); "ambiguous" raises a
+    mid-RPC error the home may already have applied."""
+
+    def __init__(self, addr="10.9.9.9:1051", fail=None) -> None:
+        self.addr = addr
+        self.fail = fail
+        self.batches = []
+
+    def info(self) -> PeerInfo:
+        return PeerInfo(grpc_address=self.addr)
+
+    async def get_peer_rate_limits_batch(self, reqs):
+        if self.fail == "unsent":
+            raise PeerNotReadyError("peer queue full")
+        if self.fail == "ambiguous":
+            raise RuntimeError("socket reset mid-RPC")
+        self.batches.append(list(reqs))
+        return [RateLimitResp(limit=r.limit) for r in reqs]
+
+
+class _Picker:
+    def __init__(self, peer) -> None:
+        self.peer = peer
+
+    def size(self) -> int:
+        return 1 if self.peer is not None else 0
+
+    def get(self, key):
+        return self.peer
+
+
+class _FakeService:
+    """Just the attributes RegionManager (and _leasable_limit /
+    drop_rehomed) dereference — no daemon, no device."""
+
+    def __init__(self, name="east", wan_regions=("west",), peer=None):
+        self.cfg = SimpleNamespace(
+            data_center=name,
+            region_picker_hash="xx",
+            behaviors=SimpleNamespace(
+                multi_region_timeout_s=2.0,
+                multi_region_batch_limit=100,
+            ),
+        )
+        self._pickers = {rg: _Picker(peer) for rg in wan_regions}
+        self.region_picker = SimpleNamespace(
+            pickers=lambda: dict(self._pickers)
+        )
+        self.metrics = None
+        self.leases = None
+        self.regions = None
+        self.local_status = Status.UNDER_LIMIT
+        self.checked = []  # every batch handed to _check_local
+        self.spawned = []  # every coroutine handed to spawn_task
+
+    def _resolve_reset_ms(self, req) -> int:
+        return 1234
+
+    async def _check_local(self, reqs):
+        self.checked.append(list(reqs))
+        return [
+            RateLimitResp(
+                status=self.local_status, limit=r.limit,
+                remaining=max(0, r.limit - r.hits), reset_time=1234,
+            )
+            for r in reqs
+        ]
+
+    def spawn_task(self, coro):
+        self.spawned.append(coro)
+
+    def drain_spawned(self):
+        for c in self.spawned:
+            c.close()
+        self.spawned = []
+
+
+def _manager(name="east", peer=None, fraction=0.25, drift_max=10_000):
+    svc = _FakeService(name=name, peer=peer)
+    cfg = RegionConfig(
+        enabled=True, name=name,
+        peers={"east": [], "west": []},
+        fraction=fraction, reconcile_ms=50, drift_max=drift_max,
+    )
+    return svc, RegionManager(svc, cfg)
+
+
+def _key_homed(rm, region, name="t"):
+    for i in range(5000):
+        k = f"k{i}"
+        if rm.home_region(f"{name}_{k}") == region:
+            return k
+    raise AssertionError(f"no key homed in {region}")
+
+
+# ---------------------------------------------------------------------
+# knob validation
+# ---------------------------------------------------------------------
+
+def test_region_config_validation():
+    with pytest.raises(ValueError, match="fraction"):
+        RegionConfig(fraction=0.0)
+    with pytest.raises(ValueError, match="fraction"):
+        RegionConfig(fraction=1.5)
+    with pytest.raises(ValueError, match="reconcile_ms"):
+        RegionConfig(reconcile_ms=0)
+    with pytest.raises(ValueError, match="drift_max"):
+        RegionConfig(drift_max=0)
+    # A daemon must appear in its own universe.
+    with pytest.raises(ValueError, match="missing from the region"):
+        RegionConfig(name="use1", peers={"euw1": []})
+
+
+def test_region_env_parse_names_env_surface(monkeypatch):
+    monkeypatch.setenv("GUBER_REGION_FRACTION", "2.0")
+    with pytest.raises(ValueError, match="GUBER_REGION_FRACTION"):
+        region_config_from_env()
+    monkeypatch.setenv("GUBER_REGION_ENABLED", "true")
+    monkeypatch.setenv("GUBER_REGION_NAME", "use1")
+    monkeypatch.setenv(
+        "GUBER_REGION_PEERS", "use1=,euw1=10.0.0.2:81|10.0.0.3:81"
+    )
+    monkeypatch.setenv("GUBER_REGION_FRACTION", "0.5")
+    monkeypatch.setenv("GUBER_REGION_RECONCILE_MS", "250")
+    monkeypatch.setenv("GUBER_REGION_DRIFT_MAX", "500")
+    cfg = region_config_from_env()
+    assert cfg.enabled and cfg.name == "use1"
+    assert cfg.peers == {
+        "use1": [], "euw1": ["10.0.0.2:81", "10.0.0.3:81"],
+    }
+    assert cfg.fraction == 0.5
+    assert cfg.reconcile_ms == 250 and cfg.drift_max == 500
+    monkeypatch.setenv("GUBER_REGION_PEERS", "not-a-peer-map")
+    with pytest.raises(ValueError, match="GUBER_REGION"):
+        region_config_from_env()
+
+
+def test_region_peer_map_parse():
+    assert _parse_region_peers("") == {}
+    assert _parse_region_peers("use1=a:81|b:81, euw1=c:81") == {
+        "use1": ["a:81", "b:81"], "euw1": ["c:81"],
+    }
+    # Naming a region with no seeds is legal (discovery supplies them).
+    assert _parse_region_peers("use1=") == {"use1": []}
+    with pytest.raises(ValueError, match="not region=addr"):
+        _parse_region_peers("use1")
+    with pytest.raises(ValueError, match="empty region name"):
+        _parse_region_peers("=a:81")
+
+
+# ---------------------------------------------------------------------
+# home picking: deterministic rendezvous over a monotonic universe
+# ---------------------------------------------------------------------
+
+def test_home_pick_agrees_across_views_and_uses_both_regions():
+    _, east = _manager("east")
+    _, west = _manager("west")
+    homes = [east.home_region(f"t_k{i}") for i in range(300)]
+    assert homes == [west.home_region(f"t_k{i}") for i in range(300)]
+    assert set(homes) == {"east", "west"}
+
+
+def test_single_region_universe_homes_everything_locally():
+    svc = _FakeService(name="solo", wan_regions=())
+    rm = RegionManager(svc, RegionConfig(enabled=True, name="solo"))
+    assert rm.universe() == ("solo",)
+    assert rm.remote_home("t_anything") is None
+
+
+def test_universe_is_monotonic_across_remaps():
+    svc = _FakeService(name="east", wan_regions=("west",))
+    rm = RegionManager(svc, RegionConfig(enabled=True))
+    assert rm.universe() == ("east", "west")
+    # The west picker vanishes (a partition dropped its peers): the
+    # universe must NOT shrink, or every west-homed key would silently
+    # re-home east and widen admission.
+    svc._pickers = {}
+    rm.on_remap()
+    assert rm.universe() == ("east", "west")
+    svc.drain_spawned()
+
+
+# ---------------------------------------------------------------------
+# the carve serve path
+# ---------------------------------------------------------------------
+
+def test_serve_carves_bounded_slot_and_queues_burn():
+    svc, rm = _manager("east", fraction=0.25)
+    key = _key_homed(rm, "west")
+    req = _req(key, behavior=Behavior.GLOBAL)
+    hk = req.hash_key()
+    assert rm.remote_home(hk) == "west"
+
+    resp = asyncio.run(rm.serve(req, hk, "west"))
+    assert resp.status == Status.UNDER_LIMIT
+    assert resp.metadata["region"] == "west"
+    assert resp.metadata["region_serve"] == "carve"
+    (carve,) = svc.checked[0]
+    assert carve.unique_key == key + REGION_SUFFIX
+    assert carve.limit == int(LIMIT * 0.25)
+    assert not int(carve.behavior) & int(Behavior.GLOBAL)
+    assert not int(carve.behavior) & int(Behavior.MULTI_REGION)
+    assert rm.carve_served == 1
+    # The admitted hit is a burn the home must absorb.
+    link = rm._link("west")
+    assert rm.drift_hits == 1
+    assert link.pending[hk].hits == 1
+    # The slot is remembered for the census and for stale-drop.
+    assert rm.carve_slot_keys() == [carve.hash_key()]
+    assert rm.carve_slot_keys()[0].endswith(REGION_SUFFIX)
+
+
+def test_serve_denied_hits_never_reconcile():
+    svc, rm = _manager("east")
+    svc.local_status = Status.OVER_LIMIT
+    key = _key_homed(rm, "west")
+    hk = f"t_{key}"
+    resp = asyncio.run(rm.serve(_req(key), hk, "west"))
+    assert resp.status == Status.OVER_LIMIT
+    assert resp.metadata["region_serve"] == "carve"
+    assert rm.drift_hits == 0
+    assert not rm._link("west").pending
+
+
+def test_serve_deny_all_stays_deny_all():
+    svc, rm = _manager("east")
+    key = _key_homed(rm, "west")
+    resp = asyncio.run(rm.serve(_req(key, limit=0), f"t_{key}", "west"))
+    assert resp.status == Status.OVER_LIMIT
+    assert not svc.checked  # the max(1, ...) floor never ran
+
+
+def test_serve_refuses_past_drift_max():
+    svc, rm = _manager("east", drift_max=5)
+    rm.drift_hits = 5
+    key = _key_homed(rm, "west")
+    resp = asyncio.run(rm.serve(_req(key), f"t_{key}", "west"))
+    assert resp.status == Status.OVER_LIMIT
+    assert resp.metadata["region_drift"] == "max"
+    assert rm.drift_refused == 1
+    assert not svc.checked
+
+
+def test_serve_pauses_during_rehome_phases():
+    svc, rm = _manager("east")
+    key = _key_homed(rm, "west")
+    rm._link("west").state = REGION_PREPARE
+    resp = asyncio.run(rm.serve(_req(key), f"t_{key}", "west"))
+    assert resp.status == Status.OVER_LIMIT
+    assert resp.metadata["region_rehome"] == REGION_PREPARE
+    assert not svc.checked
+
+
+def test_queue_burn_aggregates_per_key():
+    _, rm = _manager("east")
+    rm.queue_burn("west", _req("k", hits=2))
+    rm.queue_burn("west", _req("k", hits=3))
+    rm.queue_burn("west", _req("other", hits=1))
+    link = rm._link("west")
+    assert link.pending["t_k"].hits == 5
+    assert rm.drift_hits == 6
+
+
+# ---------------------------------------------------------------------
+# the WAN reconcile lane: at-most-once
+# ---------------------------------------------------------------------
+
+def test_reconcile_requeues_provably_unsent_and_degrades():
+    peer = _WanPeer(fail="unsent")
+    svc, rm = _manager("east", peer=peer)
+    rm.queue_burn("west", _req("k", hits=4))
+    link = rm._link("west")
+    asyncio.run(rm._flush_region("west", rm._take_region("west")))
+    # Nothing was delivered: the backlog (and its drift) survives.
+    assert link.pending["t_k"].hits == 4
+    assert rm.drift_hits == 4
+    assert rm.reconcile_sends == 0 and rm.reconcile_dropped == 0
+    assert link.state == REGION_DEGRADED
+
+
+def test_reconcile_drops_ambiguous_failures():
+    peer = _WanPeer(fail="ambiguous")
+    svc, rm = _manager("east", peer=peer)
+    rm.queue_burn("west", _req("k", hits=4))
+    link = rm._link("west")
+    asyncio.run(rm._flush_region("west", rm._take_region("west")))
+    # The home MAY have applied the batch — a re-send could double
+    # count, so the burns leave the ledger and the drop is counted.
+    assert not link.pending
+    assert rm.drift_hits == 0
+    assert rm.reconcile_dropped == 4
+    assert link.state == REGION_REMOTE
+
+
+def test_reconcile_delivery_settles_drift_and_strips_behaviors():
+    peer = _WanPeer()
+    svc, rm = _manager("east", peer=peer)
+    rm.queue_burn(
+        "west",
+        _req("k", hits=3, behavior=Behavior.GLOBAL),
+    )
+    asyncio.run(rm._flush_region("west", rm._take_region("west")))
+    assert rm.drift_hits == 0
+    assert rm.reconcile_sends == 1
+    (wire,) = peer.batches[0]
+    assert not int(wire.behavior) & int(Behavior.GLOBAL)
+    assert not int(wire.behavior) & int(Behavior.MULTI_REGION)
+
+
+def test_delivery_while_degraded_triggers_rehome():
+    peer = _WanPeer()
+    svc, rm = _manager("east", peer=peer)
+    link = rm._link("west")
+    link.state = REGION_DEGRADED
+    rm.queue_burn("west", _req("k", hits=2))
+    asyncio.run(rm._flush_region("west", rm._take_region("west")))
+    # The successful delivery IS the heal signal.
+    assert len(svc.spawned) == 1
+    asyncio.run(svc.spawned.pop())
+    assert link.state == REGION_REMOTE
+    assert rm.rehomes == 1
+
+
+# ---------------------------------------------------------------------
+# heal: the rejoin state machine
+# ---------------------------------------------------------------------
+
+class _FakeLeases:
+    def __init__(self) -> None:
+        self.dropped = []
+
+    async def drop_rehomed(self, region: str) -> int:
+        self.dropped.append(region)
+        return 0
+
+
+def test_rehome_over_reshard_drops_only_moved_slots():
+    """The rejoin-over-reshard regression: placement changed while the
+    link was degraded, so at CUTOVER one remembered carve slot is no
+    longer west-homed.  Heal must drop EXACTLY that slot — the
+    surviving slot keeps its consumed state (resetting it would hand
+    the region a fresh fraction per heal, the gubproof negative
+    control's widening)."""
+    peer = _WanPeer()
+    svc, rm = _manager("east", peer=peer)
+    svc.leases = _FakeLeases()
+    still = _key_homed(rm, "west")
+    moved = _key_homed(rm, "east")
+    link = rm._link("west")
+    link.state = REGION_DEGRADED
+
+    def _reset(key):
+        return dc_replace(
+            _req(key, hits=0, limit=25),
+            unique_key=key + REGION_SUFFIX,
+            behavior=Behavior.RESET_REMAINING,
+        )
+
+    link.resets = {
+        f"t_{still}": _reset(still),
+        f"t_{moved}": _reset(moved),
+    }
+    rm.queue_burn("west", _req(still, hits=2))
+    asyncio.run(rm._rehome("west"))
+
+    assert link.state == REGION_REMOTE
+    assert rm.rehomes == 1
+    assert rm.drift_hits == 0  # TRANSFER compensated the late burns
+    assert svc.leases.dropped == ["west"]
+    # Only the re-homed key's slot was dropped...
+    assert list(link.resets) == [f"t_{still}"]
+    (dropped,) = svc.checked[-1]
+    assert dropped.unique_key == moved + REGION_SUFFIX
+    assert int(dropped.behavior) & int(Behavior.RESET_REMAINING)
+    # ...and no reset ever targeted the surviving slot.
+    assert not any(
+        r.unique_key == still + REGION_SUFFIX
+        for batch in svc.checked for r in batch
+    )
+
+
+def test_rehome_aborts_to_degraded_when_transfer_cannot_drain():
+    peer = _WanPeer(fail="unsent")
+    svc, rm = _manager("east", peer=peer)
+    link = rm._link("west")
+    link.state = REGION_DEGRADED
+    rm.queue_burn("west", _req("k", hits=3))
+    asyncio.run(rm._rehome("west"))
+    # Compensation never landed: not healed, backlog intact.
+    assert link.state == REGION_DEGRADED
+    assert rm.rehomes == 0
+    assert link.pending["t_k"].hits == 3
+    assert rm.drift_hits == 3
+    assert not link.rehoming
+
+
+def test_debug_vars_shape():
+    _, rm = _manager("east")
+    rm.queue_burn("west", _req("k", hits=2))
+    v = rm.debug_vars()
+    assert v["name"] == "east"
+    assert v["universe"] == ["east", "west"]
+    assert v["drift"] == 2
+    assert v["links"]["west"]["pending_hits"] == 2
+    assert v["links"]["west"]["state"] == REGION_REMOTE
+
+
+# ---------------------------------------------------------------------
+# lease interplay: grants in a remote region carve from the fraction
+# ---------------------------------------------------------------------
+
+def test_lease_grants_carve_from_region_fraction():
+    """The lease-in-remote-region regression: a holder in a non-home
+    region must size against the region carve, not the full limit —
+    otherwise lease holders widen the region bound."""
+    svc, rm = _manager("east", fraction=0.25)
+    svc.regions = rm
+    lm = LeaseManager(svc, LeaseConfig(fraction=0.5))
+    remote = _req(_key_homed(rm, "west"))
+    home = _req(_key_homed(rm, "east"))
+    assert lm._leasable_limit(remote) == int(LIMIT * 0.25)
+    assert lm._leasable_limit(home) == LIMIT
+    # The nested carve: 0.5 x (0.25 x 100) = 12, not 0.5 x 100 = 50.
+    assert lm.allowance_of(lm._leasable_limit(remote)) == 12
+
+
+def test_lease_drop_rehomed_revokes_only_that_regions_keys():
+    svc, rm = _manager("east")
+    svc.regions = rm
+    lm = LeaseManager(svc, LeaseConfig())
+    west_key = f"t_{_key_homed(rm, 'west')}"
+    east_key = f"t_{_key_homed(rm, 'east')}"
+    for key in (west_key, east_key):
+        ks = _KeyState()
+        ks.holders["c1"] = _Holder(allowance=5, expires_ms=2**62)
+        ks.slot_reset = dc_replace(
+            _req(key, hits=0), behavior=Behavior.RESET_REMAINING,
+        )
+        lm._keys[key] = ks
+    revoked = asyncio.run(lm.drop_rehomed("west"))
+    assert revoked == 1
+    assert west_key not in lm._keys and east_key in lm._keys
+    (dropped,) = svc.checked[-1]
+    assert dropped.unique_key == west_key
+
+
+# ---------------------------------------------------------------------
+# cluster tier: carve bound exact, burns reconcile into the home row
+# ---------------------------------------------------------------------
+
+def test_remote_region_serves_carve_and_reconciles():
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.testing.cluster import Cluster
+
+    fraction = 0.25
+    carve = int(LIMIT * fraction)
+    conf = DaemonConfig(
+        region=RegionConfig(
+            enabled=True, fraction=fraction, reconcile_ms=100,
+            drift_max=10_000,
+        )
+    )
+    cluster = Cluster.start_with(["east", "west"], conf_template=conf)
+    try:
+        by_region = {
+            d.conf.data_center: d for d in cluster.daemons
+        }
+        east, west = by_region["east"], by_region["west"]
+        rm = east.service.regions
+        assert rm is not None
+        def _universe_converged():
+            assert set(rm.universe()) == {"east", "west"}
+
+        until_pass(_universe_converged, timeout=10.0)
+        key = _key_homed(rm, "west")
+        cl = V1Client(east.grpc_address)
+        try:
+            admitted = 0
+            for _ in range(carve + 10):
+                r = cl.get_rate_limits([_req(key)], timeout=30)[0]
+                assert not r.error, r
+                assert r.metadata.get("region") == "west"
+                assert r.metadata.get("region_serve") == "carve"
+                if r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+            # The remote region admits EXACTLY its carve — never one
+            # hit over, and never a WAN RTT on the request path.
+            assert admitted == carve
+
+            # The burns reconcile into the home region's
+            # authoritative row: west's base row consumed == carve.
+            def reconciled():
+                row = west.service.backend.get_cache_item(f"t_{key}")
+                assert row is not None
+                assert LIMIT - int(row.remaining) == carve
+                assert rm.drift_hits == 0
+
+            until_pass(reconciled)
+            assert rm.reconcile_sends >= 1
+            assert rm.reconcile_dropped == 0
+        finally:
+            cl.close()
+    finally:
+        cluster.stop()

@@ -47,6 +47,7 @@ CLASS_LOCK_MAP = {
     ("LeaseManager", "_lock"): "lease._lock",
     ("_LeaseTable", "_lock"): "lease.client._lock",
     ("ReshardManager", "_lock"): "reshard._lock",
+    ("RegionManager", "_lock"): "multiregion._lock",
     ("ColdTier", "_lock"): "coldtier._lock",
     ("TenantAccounting", "_lock"): "gubstat._lock",
     ("HdrRecorder", "_lock"): "loadgen.hdr._lock",
@@ -81,6 +82,8 @@ VAR_ALIAS = {
     "cold": "coldtier",
     "coldtier": "coldtier",
     "ct": "coldtier",
+    "regions": "multiregion",
+    "rm": "multiregion",
 }
 # Declared global acquisition order (lower rank acquired first).
 # flightrec._lock ranks LAST: any layer may record into the flight
@@ -147,6 +150,14 @@ RANK = {
     # any device work (extraction/injection ride the device executor
     # outside it).
     "reshard._lock": 58,
+    # multiregion._lock (runtime/multiregion.py burn ledger / carve
+    # reset memory / drift counter) follows the reshard contract:
+    # taken from the serve/flush/cutover paths and the gubstat census
+    # (carve_slot_keys) holding nothing, never held across an await or
+    # device work (carve checks ride _check_local outside it), and
+    # takes nothing while held (drift gauge updates happen after
+    # release).
+    "multiregion._lock": 58.5,
     # gubstat._lock (runtime/gubstat.py tenant ledger) is a leaf: taken
     # from the _check_local tail (event loop) and fast-lane fetch
     # threads while holding nothing, guards only dict/CMS state, and

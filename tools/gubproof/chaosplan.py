@@ -58,6 +58,16 @@ _FAULT_RULES: Dict[str, Dict[str, object]] = {
         "method": "*Handoff*", "probability": 1.0, "max_count": 2,
         "message": "gubproof: sender silenced until watchdog fires",
     },
+    # A region partition: every WAN arc toward the home region refuses
+    # at connect (provably unsent — the carve keeps serving and burns
+    # re-queue; the broken cutover-reset variant's counterexample rides
+    # the same fault, the widening happens at heal).
+    "fault:partition": {
+        "op": "error", "where": "client", "phase": "before",
+        "method": "*GetPeerRateLimits*", "probability": 1.0,
+        "status": "UNAVAILABLE", "max_count": 8,
+        "message": "gubproof: region WAN lane severed (partition)",
+    },
     # breaker probe failures: the peer path the breaker wraps.
     "fail": {
         "op": "error", "where": "client", "phase": "before",

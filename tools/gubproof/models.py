@@ -24,6 +24,9 @@ explorer fails if a maximum is exceeded OR never reached):
   tier           admitted <= L(1 + cycles)         == 12  (L=4, 2 cycles)
   reshard+lease  admitted <= L(1 + H*f + f_h)      == 7   (delivered)
                  ... + L on loss                   == 11  (lost -> fresh)
+  region         admitted <= L(1 + (R-1)*f_R)      == 5   (L=4, R=2, f_R=1/4)
+  region+reshard admitted <= L(1 + f_h) + f_R*L    == 6   (delivered)
+                 ... + L on loss                   == 10  (lost -> fresh)
 
 Faithfulness notes (scope limits, docs/gubproof.md):
   * models are single-window — Gregorian/window-reset behavior and
@@ -34,7 +37,11 @@ Faithfulness notes (scope limits, docs/gubproof.md):
   * `ReshardModel(replay_guard=False)` deliberately removes the
     `seen_fps` replay guard — the resulting counterexample (a
     re-delivered Migrate chunk re-inflating a row) is the seeded
-    chaos-plan round-trip fixture in tests/test_gubproof.py.
+    chaos-plan round-trip fixture in tests/test_gubproof.py;
+  * `RegionModel(cutover_reset=True)` deliberately restores the carve
+    slot's allowance at region cutover — the counterexample (partition
+    -> burn the carve -> heal -> burn a fresh carve in the same
+    window) is the second seeded chaos-plan fixture there.
 """
 from __future__ import annotations
 
@@ -681,9 +688,278 @@ class ReshardLeaseModel(Model):
         ),)
 
 
+# ---------------------------------------------------------------------------
+# region: carve serve / WAN reconcile / partition / rehome
+# ---------------------------------------------------------------------------
+class RegionModel(Model):
+    """RegionConfig scope: R=2 regions, one key homed in the REMOTE
+    region, L=4, region_fraction=1/4 (carve C=1).  This node's view:
+    the home row's budget, the local carve slot, the reconcile
+    backlog, and the link state machine.  State:
+    (link, home_rem, carve_rem, pending, admitted).
+
+    The exact closure: admitted == L x (1 + (R-1) x f) == 5, reached
+    by draining both budgets, never exceeded because the carve slot
+    is NEVER reset at cutover — `cutover_reset=True` restores the
+    carve's allowance on every heal (the tempting-but-wrong
+    compensation), and its counterexample (partition -> burn the
+    carve -> heal -> burn again) is the second seeded chaos-plan
+    fixture in tests/test_gubproof.py."""
+
+    name = "region"
+    L, C = 4, 1
+    covered = (("region", "link"),)
+    expect_max = {"admitted": 5}  # L * (1 + (R-1) * fraction)
+
+    def __init__(self, specs, cutover_reset: bool = False) -> None:
+        super().__init__(specs)
+        self.cutover_reset = cutover_reset
+        if cutover_reset:
+            self.name = "region-cutover-reset"
+
+    def initial(self) -> tuple:
+        return ("remote", self.L, self.C, 0, 0)
+
+    def _e(self, eid: str) -> Tuple[EdgeRef, ...]:
+        return (("region", "link", eid, None),)
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        link, home, carve, pending, adm = s
+        if home > 0:
+            # A check landing in the HOME region: full budget.
+            yield (
+                "serve:home", (),
+                (link, home - 1, carve, pending, adm + 1),
+            )
+        if carve > 0 and link in ("remote", "degraded"):
+            # A remote-homed check served from the local carve slot;
+            # the admitted burn queues toward home.
+            yield (
+                "serve:carve", (),
+                (link, home, carve - 1, pending + 1, adm + 1),
+            )
+        if pending > 0 and link == "remote":
+            # The WAN reconcile cadence: the burn lands at home and
+            # debits the authoritative row (admitting nothing — a
+            # saturated row simply denies it).
+            yield (
+                "reconcile:flush", (),
+                (link, max(0, home - 1), carve, pending - 1, adm),
+            )
+        if link == "remote":
+            yield (
+                "fault:partition", self._e("wan_lost"),
+                ("degraded", home, carve, pending, adm),
+            )
+        if link == "degraded":
+            yield (
+                "rehome:heal", self._e("heal_prepare"),
+                ("region_prepare", home, carve, pending, adm),
+            )
+        if link == "region_prepare":
+            yield (
+                "rehome:transfer", self._e("prepare_transfer"),
+                ("transfer", home, carve, pending, adm),
+            )
+        if link == "transfer":
+            if pending > 0:
+                # The cutover compensation: late burns drain to home.
+                yield (
+                    "rehome:drain", (),
+                    (link, max(0, home - 1), carve, pending - 1, adm),
+                )
+            else:
+                yield (
+                    "rehome:cutover", self._e("transfer_cutover"),
+                    ("cutover", home, carve, pending, adm),
+                )
+            # The WAN can die again mid-transfer: abort to degraded.
+            yield (
+                "fault:partition", self._e("wan_lost"),
+                ("degraded", home, carve, pending, adm),
+            )
+        if link == "cutover":
+            ncarve = self.C if self.cutover_reset else carve
+            yield (
+                "rehome:remote", self._e("cutover_remote"),
+                ("remote", home, ncarve, pending, adm),
+            )
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        _link, home, carve, _pending, adm = s
+        bound = self.L + self.C
+        if adm > bound:
+            return (
+                f"admitted {adm} > limit x (1 + remote_regions x "
+                f"region_fraction) = {bound}"
+            )
+        if adm + home + carve > bound:
+            return (
+                f"budget inflated: admitted {adm} + outstanding "
+                f"{home + carve} > {bound} (a heal must not refresh "
+                "the carve's window allowance)"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        return {"admitted": s[4]}
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        return {("region", "link", None): s[0]}
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return (
+            (
+                "region-link-reheals",
+                lambda s: s[0] != "remote",
+                lambda s: s[0] == "remote",
+            ),
+            (
+                "region-drift-drains",
+                lambda s: s[3] > 0,
+                lambda s: s[3] == 0,
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# composition: the home region reshards while a remote region carves
+# ---------------------------------------------------------------------------
+class RegionReshardModel(Model):
+    """Region rejoin rides the reshard machinery INSIDE the home
+    region: while a remote region serves from its carve and reconciles
+    over the WAN, the home region's ring remaps the key old owner A ->
+    new owner B (handoff shadow and all).  The algebra must close over
+    the sum: the home handoff budget plus the remote carve.
+
+    Scope: L=4, handoff shadow 1, carve C=1.  State:
+    (link, carve_rem, pending, *reshard sub-state, admitted).  WAN
+    flushes debit whichever home budget is live (the row wherever the
+    handoff moved it, or the fresh self-cutover row)."""
+
+    name = "region_reshard"
+    L, SHADOW, C = 4, 1, 1
+    covered = ()  # bounds composition; edge coverage rides the per-plane models
+    expect_max = {"admitted_clean": 6, "admitted_lost": 10}
+    state_cap = 600_000
+
+    def initial(self) -> tuple:
+        return (
+            "remote", self.C, 0,
+            "prepare", "none", "old", self.L, self.SHADOW, 0, 0, 0, 0,
+            0,
+        )
+
+    def _e(self, eid: str) -> Tuple[EdgeRef, ...]:
+        return (("region", "link", eid, None),)
+
+    @staticmethod
+    def _debit_home(rs: tuple) -> tuple:
+        """A reconciled burn lands in the home region and debits the
+        live budget there: the fresh row after a lossy self-cutover,
+        else the moved row wherever the handoff left it.  A saturated
+        (or lost) row absorbs nothing — the burn is simply denied."""
+        ob, ib, row, rowA, sh, led, fresh, frem, snap = rs
+        if fresh:
+            return (ob, ib, row, rowA, sh, led, fresh, max(0, frem - 1), snap)
+        if row != "lost":
+            return (ob, ib, row, max(0, rowA - 1), sh, led, fresh, frem, snap)
+        return rs
+
+    def successors(self, s: tuple) -> Iterable[Succ]:
+        link, carve, pending = s[0], s[1], s[2]
+        rs, adm = s[3:12], s[12]
+
+        def pack(link=link, carve=carve, pending=pending, rs=rs, adm=adm):
+            return (link, carve, pending) + rs + (adm,)
+
+        if carve > 0 and link in ("remote", "degraded"):
+            yield (
+                "serve:carve", (),
+                pack(carve=carve - 1, pending=pending + 1, adm=adm + 1),
+            )
+        if pending > 0 and link == "remote":
+            yield (
+                "reconcile:flush", (),
+                pack(pending=pending - 1, rs=self._debit_home(rs)),
+            )
+        if link == "remote":
+            yield (
+                "fault:partition", self._e("wan_lost"),
+                pack(link="degraded"),
+            )
+        if link == "degraded":
+            yield ("rehome:heal", self._e("heal_prepare"),
+                   pack(link="region_prepare"))
+        if link == "region_prepare":
+            yield ("rehome:transfer", self._e("prepare_transfer"),
+                   pack(link="transfer"))
+        if link == "transfer":
+            if pending > 0:
+                yield (
+                    "rehome:drain", (),
+                    pack(pending=pending - 1, rs=self._debit_home(rs)),
+                )
+            else:
+                yield ("rehome:cutover", self._e("transfer_cutover"),
+                       pack(link="cutover"))
+        if link == "cutover":
+            # The slot keeps its consumed state — no per-heal refresh.
+            yield ("rehome:remote", self._e("cutover_remote"),
+                   pack(link="remote"))
+        # The home region's handoff runs concurrently with all of it.
+        for label, edges, nrs, dadm in _reshard_succs(rs, self.L, True):
+            yield (label, edges, pack(rs=nrs, adm=adm + dadm))
+
+    def invariant(self, s: tuple) -> Optional[str]:
+        carve = s[1]
+        row, rowA, sh, fresh, frem = s[5], s[6], s[7], s[9], s[10]
+        adm = s[12]
+        budget = self.L + self.SHADOW + self.C + (self.L if fresh else 0)
+        if adm > budget:
+            kind = (
+                "L x (1 + f_h) + f_R x L + L (rows lost)" if fresh
+                else "L x (1 + f_h) + f_R x L"
+            )
+            return f"admitted {adm} > {kind} = {budget}"
+        live = (rowA if row != "lost" else 0) + sh + frem + carve
+        if adm + live > budget:
+            return (
+                f"budget inflated: admitted {adm} + outstanding {live} "
+                f"> {budget}"
+            )
+        return None
+
+    def counters(self, s: tuple) -> Dict[str, int]:
+        fresh, adm = s[9], s[12]
+        return {
+            "admitted_clean": 0 if fresh else adm,
+            "admitted_lost": adm if fresh else 0,
+        }
+
+    def proj(self, s: tuple) -> Dict[Tuple[str, str, Optional[str]], Optional[str]]:
+        link, ob, ib = s[0], s[3], s[4]
+        return {
+            ("region", "link", None): link,
+            ("reshard", "outbound", None): ob,
+            ("reshard", "inbound", None): (
+                ib if ib in ("prepare", "transfer") else None
+            ),
+        }
+
+    def liveness(self) -> Tuple[Tuple[str, Callable, Callable], ...]:
+        return ((
+            "region-reshard-quiesces",
+            lambda s: s[0] != "remote" or s[2] > 0
+            or s[3] not in _TERMINAL_OB,
+            lambda s: s[0] == "remote" and s[2] == 0
+            and s[3] in _TERMINAL_OB,
+        ),)
+
+
 def build_models(specs: Sequence[ProtocolSpec]) -> List[Model]:
     """The default exploration set: one model per plane spec present,
-    plus the reshard+lease composition when both of its specs are."""
+    plus the compositions when both of their specs are."""
     ids = {s.id for s in specs}
     out: List[Model] = []
     if "breaker" in ids:
@@ -694,6 +970,10 @@ def build_models(specs: Sequence[ProtocolSpec]) -> List[Model]:
         out.append(ReshardModel(specs))
     if "tier" in ids:
         out.append(TierModel(specs))
+    if "region" in ids:
+        out.append(RegionModel(specs))
     if "reshard" in ids and "lease" in ids:
         out.append(ReshardLeaseModel(specs))
+    if "region" in ids and "reshard" in ids:
+        out.append(RegionReshardModel(specs))
     return out
